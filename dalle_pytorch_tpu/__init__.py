@@ -1,0 +1,8 @@
+"""dalle_pytorch_tpu — a TPU-native (JAX/XLA/Pallas) text-to-image framework
+with the capability set of lucidrains/DALLE-pytorch, designed from scratch for
+TPU hardware: functional models over parameter pytrees, static-shape jitted
+train/sample steps, attention sparsity as static masks + Pallas kernels, and
+distribution via mesh sharding instead of NCCL all-reduce."""
+from dalle_pytorch_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,32 @@
+from dalle_pytorch_tpu.core.module import (
+    Initializer,
+    conv2d,
+    conv2d_init,
+    conv2d_transpose,
+    conv2d_transpose_init,
+    embedding,
+    embedding_init,
+    layer_norm,
+    layer_norm_init,
+    linear,
+    linear_init,
+)
+from dalle_pytorch_tpu.core.rng import KeyChain
+from dalle_pytorch_tpu.core.pytree import param_count, tree_size_bytes
+
+__all__ = [
+    "Initializer",
+    "KeyChain",
+    "conv2d",
+    "conv2d_init",
+    "conv2d_transpose",
+    "conv2d_transpose_init",
+    "embedding",
+    "embedding_init",
+    "layer_norm",
+    "layer_norm_init",
+    "linear",
+    "linear_init",
+    "param_count",
+    "tree_size_bytes",
+]

@@ -1,0 +1,160 @@
+"""Minimal functional NN substrate.
+
+Every layer is an (init, apply) pair over plain dict pytrees — no module
+objects, no hidden state, no global RNG.  This is the TPU-native replacement
+for the reference's torch.nn module graph: pure functions compose cleanly with
+jit / grad / scan / shard_map, weight sharing is a dict lookup, and custom-VJP
+engines (reversible blocks) can recompute activations without RNG
+capture/restore machinery.
+
+Conventions
+-----------
+* Arrays are NHWC for images (TPU-canonical layout) and (batch, seq, dim) for
+  sequences.
+* Linear weights are (in, out); conv kernels are HWIO.
+* Initialization mirrors torch defaults (uniform ±1/sqrt(fan_in) for
+  linear/conv, N(0,1) for embeddings) so training dynamics match the
+  reference without copying any code.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    """Namespace of weight initializers (all return f32)."""
+
+    @staticmethod
+    def uniform_fan_in(key, shape, fan_in):
+        bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+        return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+    @staticmethod
+    def normal(key, shape, stddev=1.0):
+        return jax.random.normal(key, shape, jnp.float32) * stddev
+
+
+# ---------------------------------------------------------------------------
+# linear
+# ---------------------------------------------------------------------------
+
+def linear_init(key, in_dim: int, out_dim: int, bias: bool = True):
+    wkey, bkey = jax.random.split(key)
+    params = {"w": Initializer.uniform_fan_in(wkey, (in_dim, out_dim), in_dim)}
+    if bias:
+        params["b"] = Initializer.uniform_fan_in(bkey, (out_dim,), in_dim)
+    return params
+
+
+def linear(params, x):
+    y = jnp.dot(x, params["w"], preferred_element_type=x.dtype)
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# layer norm
+# ---------------------------------------------------------------------------
+
+def layer_norm_init(dim: int):
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm(params, x, eps: float = 1e-5):
+    # Normalize in f32 for bf16 stability, cast back to input dtype.
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+def embedding_init(key, num_embeddings: int, dim: int):
+    return {"table": Initializer.normal(key, (num_embeddings, dim))}
+
+
+def embedding(params, ids):
+    return jnp.take(params["table"], ids, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# conv2d (NHWC, HWIO)
+# ---------------------------------------------------------------------------
+
+_CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d_init(key, in_chan: int, out_chan: int, kernel: int, bias: bool = True):
+    wkey, bkey = jax.random.split(key)
+    fan_in = in_chan * kernel * kernel
+    params = {"w": Initializer.uniform_fan_in(wkey, (kernel, kernel, in_chan, out_chan), fan_in)}
+    if bias:
+        params["b"] = Initializer.uniform_fan_in(bkey, (out_chan,), fan_in)
+    return params
+
+
+def conv2d(params, x, stride: int = 1, padding="SAME"):
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    y = jax.lax.conv_general_dilated(
+        x,
+        params["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=_CONV_DIMS,
+    )
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return y
+
+
+def conv2d_transpose_init(key, in_chan: int, out_chan: int, kernel: int, bias: bool = True):
+    wkey, bkey = jax.random.split(key)
+    fan_in = in_chan * kernel * kernel
+    params = {"w": Initializer.uniform_fan_in(wkey, (kernel, kernel, in_chan, out_chan), fan_in)}
+    if bias:
+        params["b"] = Initializer.uniform_fan_in(bkey, (out_chan,), fan_in)
+    return params
+
+
+def conv2d_transpose(params, x, stride: int = 2, kernel: int = 4, torch_padding: int = 1):
+    """Transposed conv matching torch's ConvTranspose2d(kernel, stride, padding)
+    output geometry: out = (in - 1) * stride - 2 * padding + kernel.
+
+    Implemented as an input-dilated conv (the XLA-native formulation)."""
+    pad = kernel - 1 - torch_padding
+    y = jax.lax.conv_general_dilated(
+        x,
+        params["w"].astype(x.dtype),
+        window_strides=(1, 1),
+        padding=((pad, pad), (pad, pad)),
+        lhs_dilation=(stride, stride),
+        dimension_numbers=_CONV_DIMS,
+    )
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def dropout(key: Optional[jax.Array], x, rate: float):
+    """Inverted dropout; identity when key is None or rate == 0."""
+    if key is None or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

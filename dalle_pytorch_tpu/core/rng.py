@@ -1,0 +1,27 @@
+"""Explicit PRNG-key plumbing.
+
+JAX replaces the reference's global RNG state (and its capture/restore machinery
+in /root/reference/dalle_pytorch/reversible.py:20-50) with explicit keys; the
+KeyChain is a tiny convenience for sequentially deriving keys during parameter
+initialization without threading a split through every call site.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class KeyChain:
+    """Derives a fresh key per `next()` from a root key, deterministically."""
+
+    def __init__(self, key_or_seed):
+        if isinstance(key_or_seed, int):
+            key_or_seed = jax.random.PRNGKey(key_or_seed)
+        self._key = key_or_seed
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def split(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return subs

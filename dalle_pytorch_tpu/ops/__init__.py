@@ -1,0 +1,19 @@
+from dalle_pytorch_tpu.ops.masks import build_pattern_mask, causal_mask
+from dalle_pytorch_tpu.ops.rotary import build_dalle_rotary, apply_rotary
+from dalle_pytorch_tpu.ops.sampling import gumbel_noise, gumbel_sample, prob_mask_like, top_k_filter
+from dalle_pytorch_tpu.ops.stable import divide_max, stable_softmax
+from dalle_pytorch_tpu.ops.shift import token_shift
+
+__all__ = [
+    "apply_rotary",
+    "build_dalle_rotary",
+    "build_pattern_mask",
+    "causal_mask",
+    "divide_max",
+    "gumbel_noise",
+    "gumbel_sample",
+    "prob_mask_like",
+    "stable_softmax",
+    "token_shift",
+    "top_k_filter",
+]

@@ -1,0 +1,36 @@
+"""Dense attention math shared by every attention variant.
+
+One fused op: scores on the MXU with f32 accumulation, mask applied as an
+additive fill, f32 softmax, values matmul.  Sparsity variants pass a static
+pattern mask (ops/masks.py); XLA fuses the mask into the softmax and the
+Pallas kernels (kernels/) skip fully-masked blocks outright.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.ops.stable import stable_softmax
+
+
+def attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    stable: bool = False,
+) -> jnp.ndarray:
+    """q: (..., i, d) already scaled; k/v: (..., j, d); mask: broadcastable to
+    (..., i, j), True = may attend.  Returns (..., i, d_v) in q's dtype."""
+    dtype = q.dtype
+    scores = jnp.einsum("...id,...jd->...ij", q, k, preferred_element_type=jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    if stable:
+        attn = stable_softmax(scores, axis=-1)
+    else:
+        attn = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        attn = attn / jnp.sum(attn, axis=-1, keepdims=True)
+    out = jnp.einsum("...ij,...jd->...id", attn.astype(dtype), v, preferred_element_type=jnp.float32)
+    return out.astype(dtype)

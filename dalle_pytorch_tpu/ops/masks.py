@@ -1,0 +1,85 @@
+"""Static attention-pattern masks.
+
+The reference implements its sparse attention variants as gather/unfold-based
+torch modules (/root/reference/dalle_pytorch/attention.py:103-335).  On TPU the
+idiomatic design is the one the reference itself uses for
+`optimize_for_inference` (/root/reference/dalle_pytorch/transformer.py:333-350):
+express every pattern as a static boolean mask over one dense attention — XLA
+keeps the matmuls on the MXU, and Pallas kernels can later skip fully-masked
+blocks.  Masks are built in numpy at trace time (static shapes) and are
+combined with the causal triangle inside the attention op.
+
+Layout convention: position 0..text_len-1 is [<bos> + text], positions
+text_len..text_len+fmap**2-1 are the raster-ordered image grid, where
+text_len = seq_len + 1 - fmap**2.  Masks are returned at (seq_len, seq_len),
+i.e. the layout truncated by its final position, matching the reference's
+`seq_len`-sized static masks.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+ATTN_TYPES = ("full", "axial_row", "axial_col", "conv_like", "sparse")
+
+
+def causal_mask(n: int) -> jnp.ndarray:
+    """(n, n) bool, True = may attend (j <= i)."""
+    return jnp.asarray(np.tril(np.ones((n, n), dtype=bool)))
+
+
+@lru_cache(maxsize=64)
+def _pattern_mask_np(
+    attn_type: str,
+    seq_len: int,
+    image_fmap_size: int,
+    kernel_size: int,
+    dilation: int,
+) -> np.ndarray:
+    fmap = image_fmap_size
+    img_seq_len = fmap * fmap
+    text_len = seq_len + 1 - img_seq_len
+    layout = text_len + img_seq_len  # == seq_len + 1
+
+    mask = np.zeros((layout, layout), dtype=bool)
+    mask[:, :text_len] = True  # everything attends to text (causality added later)
+
+    if attn_type == "full":
+        mask[:, :] = True
+    elif attn_type == "axial_row":
+        h = np.arange(img_seq_len) // fmap
+        same_row = h[:, None] == h[None, :]
+        mask[text_len:, text_len:] = same_row
+    elif attn_type == "axial_col":
+        w = np.arange(img_seq_len) % fmap
+        same_col = w[:, None] == w[None, :]
+        mask[text_len:, text_len:] = same_col
+    elif attn_type == "conv_like":
+        h = np.arange(img_seq_len) // fmap
+        w = np.arange(img_seq_len) % fmap
+        dh = h[:, None] - h[None, :]  # query minus key
+        dw = w[:, None] - w[None, :]
+        max_off = (kernel_size - 1) * dilation
+        ok_h = (dh >= 0) & (dh <= max_off) & (dh % dilation == 0)
+        ok_w = (dw >= 0) & (dw <= max_off) & (dw % dilation == 0)
+        mask[text_len:, text_len:] = ok_h & ok_w
+    else:
+        raise ValueError(f'attention type "{attn_type}" has no static mask')
+
+    return mask[:seq_len, :seq_len]
+
+
+def build_pattern_mask(
+    attn_type: str,
+    seq_len: int,
+    image_fmap_size: int,
+    kernel_size: int = 5,
+    dilation: int = 1,
+) -> jnp.ndarray:
+    """(seq_len, seq_len) bool pattern mask, True = may attend.  Must be
+    AND-ed with the causal triangle by the caller."""
+    return jnp.asarray(
+        _pattern_mask_np(attn_type, seq_len, image_fmap_size, kernel_size, dilation)
+    )

@@ -1,0 +1,88 @@
+"""Rotary position embeddings for the text+image joint sequence.
+
+Reproduces the scheme the reference wires up in
+/root/reference/dalle_pytorch/transformer.py:302-328: a language-style rotary
+over text positions (image tokens pinned at position 8192), concatenated with a
+pixel-style axial rotary over the image grid (text tokens pinned at -10), with
+rot_dim = dim_head // 3 per component.  The combined table is precomputed once
+(static shapes — XLA constant-folds it) and applied to q, k AND v, matching
+the reference's apply_pos_emb (/root/reference/dalle_pytorch/attention.py:32-35).
+
+Frequency conventions follow the public rotary-embedding formulation: language
+freqs 1/theta^(2i/dim); pixel freqs linspace(1, max_freq/2, dim//2) * pi; each
+frequency duplicated onto adjacent channel pairs, rotation mixes (even, odd)
+pairs as (x, y) -> (x cos - y sin, x sin + y cos).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _lang_freqs(rot_dim: int, theta: float = 10000.0) -> np.ndarray:
+    return 1.0 / (theta ** (np.arange(0, rot_dim, 2, dtype=np.float64)[: rot_dim // 2 + rot_dim % 2] / rot_dim))
+
+
+def _pixel_freqs(rot_dim: int, max_freq: float = 10.0) -> np.ndarray:
+    return np.linspace(1.0, max_freq / 2.0, rot_dim // 2, dtype=np.float64) * np.pi
+
+
+def _freqs_for_positions(positions: np.ndarray, freqs: np.ndarray) -> np.ndarray:
+    """(n,) positions x (f,) freqs -> (n, 2f) with each freq duplicated onto a
+    channel pair: [p*f0, p*f0, p*f1, p*f1, ...]."""
+    angles = np.einsum("n,f->nf", positions.astype(np.float64), freqs)
+    return np.repeat(angles, 2, axis=-1)
+
+
+def build_dalle_rotary(dim_head: int, text_len: int, image_fmap_size: int) -> jnp.ndarray:
+    """Angle table of shape (layout_len, rot_total) where layout_len =
+    text_len + image_fmap_size**2 and rot_total <= dim_head.
+
+    Layout rows are [bos + text (text_len), image raster (fmap**2)]."""
+    rot_dim = dim_head // 3
+    img_seq_len = image_fmap_size ** 2
+
+    lang = _lang_freqs(rot_dim)
+    pixel = _pixel_freqs(rot_dim)
+
+    # language component: text gets its index, image pinned far away at 8192
+    text_pos = np.arange(text_len, dtype=np.float64)
+    img_pos = np.full((img_seq_len,), 8192.0)
+    lang_part = np.concatenate(
+        [_freqs_for_positions(text_pos, lang), _freqs_for_positions(img_pos, lang)], axis=0
+    )
+
+    # pixel-axial component: image rows/cols over linspace(-1, 1), text pinned at -10
+    axial_pos = np.linspace(-1.0, 1.0, image_fmap_size)
+    axial = _freqs_for_positions(axial_pos, pixel)  # (fmap, 2*(rot_dim//2))
+    d_ax = axial.shape[-1]
+    rows = np.broadcast_to(axial[:, None, :], (image_fmap_size, image_fmap_size, d_ax))
+    cols = np.broadcast_to(axial[None, :, :], (image_fmap_size, image_fmap_size, d_ax))
+    img_axial = np.concatenate([rows, cols], axis=-1).reshape(img_seq_len, 2 * d_ax)
+
+    text_axial_half = _freqs_for_positions(np.full((text_len,), -10.0), pixel)
+    text_axial = np.concatenate([text_axial_half, text_axial_half], axis=-1)
+    axial_part = np.concatenate([text_axial, img_axial], axis=0)
+
+    table = np.concatenate([lang_part, axial_part], axis=-1)
+    assert table.shape[-1] <= dim_head, "rotary dims exceed head dim"
+    return jnp.asarray(table, dtype=jnp.float32)
+
+
+def _rotate_pairs(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2f) pairs (even, odd) -> (-odd, even)."""
+    x2 = x.reshape(*x.shape[:-1], -1, 2)
+    rotated = jnp.stack([-x2[..., 1], x2[..., 0]], axis=-1)
+    return rotated.reshape(x.shape)
+
+
+def apply_rotary(angles: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the first `angles.shape[-1]` channels of t, pass the rest through.
+
+    angles: (n, rot) or (..., n, rot); t: (..., n, dim_head)."""
+    rot = angles.shape[-1]
+    dtype = t.dtype
+    t_rot, t_pass = t[..., :rot], t[..., rot:]
+    t32 = t_rot.astype(jnp.float32)
+    out = t32 * jnp.cos(angles) + _rotate_pairs(t32) * jnp.sin(angles)
+    return jnp.concatenate([out.astype(dtype), t_pass], axis=-1)

@@ -1,0 +1,38 @@
+"""Sampling helpers (explicit-key equivalents of
+/root/reference/dalle_pytorch/dalle_pytorch.py:51-69)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def log_clamp(t: jnp.ndarray, eps: float = 1e-20) -> jnp.ndarray:
+    return jnp.log(jnp.clip(t, a_min=eps))
+
+
+def gumbel_noise(key: jax.Array, shape, dtype=jnp.float32) -> jnp.ndarray:
+    u = jax.random.uniform(key, shape, dtype)
+    return -log_clamp(-log_clamp(u))
+
+
+def gumbel_sample(key: jax.Array, logits: jnp.ndarray, temperature: float = 1.0, axis: int = -1):
+    """argmax(logits / temperature + G); with -inf-filtered logits the noise
+    leaves masked entries at -inf, so this samples from the softmax."""
+    return jnp.argmax(logits / temperature + gumbel_noise(key, logits.shape, logits.dtype), axis=axis)
+
+
+def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
+    """Keep the top max(int((1-thres)*V), 1) logits, set the rest to -inf.
+
+    Same threshold-fraction semantics as the reference's top_k; k is static
+    (derived from the vocab size), so this jits to a single lax.top_k.  Ties at
+    the k-th value are all kept (the reference's scatter keeps exactly k; the
+    difference only matters for exactly-tied logits)."""
+    num_logits = logits.shape[-1]
+    k = max(int((1.0 - thres) * num_logits), 1)
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def prob_mask_like(key: jax.Array, shape, prob: float) -> jnp.ndarray:
+    return jax.random.uniform(key, shape) < prob

@@ -1,0 +1,59 @@
+"""Token-shift mixing (the reference's PreShiftToken,
+/root/reference/dalle_pytorch/transformer.py:126-200).
+
+Text positions shift the first half of their channels back by one position;
+image positions (viewed as a fmap x fmap grid) take their first channel
+quarter from the row above and their second quarter from the left neighbour.
+The whole thing is expressed with pads/reshapes so XLA fuses it into the
+surrounding layers.  The cached single-token variant (the reference's deque)
+lives with the sampling cache machinery in models/transformer.py as a
+fixed-shape ring buffer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _shift_seq(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Shift forward by one along `axis`, padding with zeros at the front."""
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (1, 0)
+    sliced = jnp.pad(x, pad)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(0, x.shape[axis])
+    return sliced[tuple(idx)]
+
+
+def token_shift(x: jnp.ndarray, seq_len: int, image_fmap_size: int) -> jnp.ndarray:
+    """x: (batch, n, dim) where the layout is [text (text_len), image raster].
+
+    seq_len is the model's total sequence length (text_seq_len + image_seq_len);
+    text_len = seq_len + 1 - fmap**2.  Sequences shorter than text_len are
+    passed through untouched (no image tokens to shift)."""
+    b, n, d = x.shape
+    fmap = image_fmap_size
+    img_seq_len = fmap * fmap
+    text_len = seq_len + 1 - img_seq_len
+    assert d % 4 == 0, "token shift requires dim divisible by 4"
+
+    if n < text_len:
+        # text-only sequences pass through untouched, matching the reference
+        return x
+
+    x_text, x_img = x[:, :text_len], x[:, text_len:]
+
+    # text: first half of channels shifted back one position
+    t_shift, t_pass = x_text[..., : d // 2], x_text[..., d // 2 :]
+    x_text = jnp.concatenate([_shift_seq(t_shift, 1), t_pass], axis=-1)
+
+    # image: pad raster out to the full grid, shift quarters from top / left
+    n_img = x_img.shape[1]
+    x_img = jnp.pad(x_img, ((0, 0), (0, img_seq_len - n_img), (0, 0)))
+    x_img = x_img.reshape(b, fmap, fmap, d)
+    q = d // 4
+    top = _shift_seq(x_img[..., :q], 1)        # from row above
+    left = _shift_seq(x_img[..., q : 2 * q], 2)  # from left neighbour
+    x_img = jnp.concatenate([top, left, x_img[..., 2 * q :]], axis=-1)
+    x_img = x_img.reshape(b, img_seq_len, d)[:, :n_img]
+
+    return jnp.concatenate([x_text, x_img], axis=1)

@@ -1,0 +1,18 @@
+"""Numerical-stability helpers used by the `stable` DALLE variant
+(/root/reference/dalle_pytorch/attention.py:27-30 and transformer.py:29-36)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stable_softmax(t: jnp.ndarray, axis: int = -1, alpha: float = 32.0 ** 2) -> jnp.ndarray:
+    """Softmax with pre-scaled max subtraction for low-precision stability."""
+    t = t / alpha
+    t = t - jax.lax.stop_gradient(jnp.max(t, axis=axis, keepdims=True))
+    return jax.nn.softmax(t * alpha, axis=axis)
+
+
+def divide_max(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    maxes = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    return x / maxes

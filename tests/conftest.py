@@ -1,0 +1,9 @@
+"""Test harness: force a virtual 8-device CPU platform BEFORE jax imports so
+multi-chip sharding logic is exercised without TPU hardware (the JAX-native
+answer to testing multi-node without a cluster — see SURVEY.md §4)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,191 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.ops.attention import attend
+from dalle_pytorch_tpu.ops.masks import causal_mask
+from dalle_pytorch_tpu.ops.rotary import apply_rotary, build_dalle_rotary
+from dalle_pytorch_tpu.ops.sampling import gumbel_sample, prob_mask_like, top_k_filter
+from dalle_pytorch_tpu.ops.shift import token_shift
+from dalle_pytorch_tpu.ops.stable import divide_max, stable_softmax
+
+
+# --- rotary ---------------------------------------------------------------
+
+def test_rotary_table_shape():
+    dim_head, fmap = 64, 8
+    text_len = 17  # text_seq_len 16 + bos
+    seq_len = 16 + fmap * fmap
+    table = build_dalle_rotary(dim_head, text_len, fmap)
+    # rot_dim = 21 -> lang part 22 dims, pixel part 2*10*2 = 40 dims
+    assert table.shape == (text_len + fmap * fmap, 62)
+    assert table.shape[0] == seq_len + 1
+
+
+def test_rotary_preserves_norm():
+    table = build_dalle_rotary(64, 17, 8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, table.shape[0], 64))
+    y = apply_rotary(table, x)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rotary_relative_property():
+    """Rotated lang-component inner products depend only on relative distance."""
+    dim_head = 48  # rot_dim 16 -> lang part exactly 16 dims
+    table = build_dalle_rotary(dim_head, text_len=32, image_fmap_size=2)
+    lang_dims = 16
+    v = jax.random.normal(jax.random.PRNGKey(1), (lang_dims,))
+    rot = lambda pos: np.asarray(apply_rotary(table[pos, :lang_dims], v))
+    d01 = float(np.dot(rot(3), rot(4)))
+    d12 = float(np.dot(rot(10), rot(11)))
+    assert abs(d01 - d12) < 1e-4
+
+
+def test_rotary_identity_at_zero():
+    table = build_dalle_rotary(64, 17, 8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (table.shape[0], 64))
+    y = apply_rotary(table * 0.0, x)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+# --- sampling -------------------------------------------------------------
+
+def test_top_k_filter_counts():
+    logits = jnp.asarray(np.random.RandomState(0).randn(3, 100).astype(np.float32))
+    k = max(int((1 - 0.9) * 100), 1)  # the reference's exact formula (== 9)
+    out = np.asarray(top_k_filter(logits, thres=0.9))
+    assert ((out > -np.inf).sum(-1) == k).all()
+    # kept entries are exactly the k largest
+    ref = np.sort(np.asarray(logits), -1)[:, -k:]
+    for b in range(3):
+        kept = np.sort(out[b][out[b] > -np.inf])
+        np.testing.assert_allclose(kept, ref[b], rtol=1e-6)
+
+
+def test_top_k_filter_min_one():
+    logits = jnp.zeros((2, 5)).at[:, 1].set(1.0)
+    out = np.asarray(top_k_filter(logits, thres=0.999))
+    assert ((out > -np.inf).sum(-1) == 1).all()
+    assert (out.argmax(-1) == 1).all()
+
+
+def test_gumbel_sample_low_temperature_is_argmax():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]])
+    s = gumbel_sample(jax.random.PRNGKey(0), logits, temperature=1e-4)
+    np.testing.assert_array_equal(np.asarray(s), [1, 0])
+
+
+def test_gumbel_sample_distribution():
+    logits = jnp.log(jnp.asarray([0.7, 0.2, 0.1]))
+    keys = jax.random.split(jax.random.PRNGKey(0), 3000)
+    samples = jax.vmap(lambda k: gumbel_sample(k, logits))(keys)
+    freq = np.bincount(np.asarray(samples), minlength=3) / 3000
+    np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.05)
+
+
+def test_prob_mask_like():
+    m = prob_mask_like(jax.random.PRNGKey(0), (10000,), 0.3)
+    assert 0.25 < np.asarray(m).mean() < 0.35
+    assert not np.asarray(prob_mask_like(jax.random.PRNGKey(0), (10,), 0.0)).any()
+    assert np.asarray(prob_mask_like(jax.random.PRNGKey(0), (10,), 1.0)).all()
+
+
+# --- stable ---------------------------------------------------------------
+
+def test_stable_softmax_matches_softmax():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 30
+    np.testing.assert_allclose(
+        np.asarray(stable_softmax(x)), np.asarray(jax.nn.softmax(x, -1)), atol=1e-5
+    )
+
+
+def test_divide_max():
+    x = jnp.asarray([[1.0, 2.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(divide_max(x)), [[0.25, 0.5, 1.0]])
+
+
+# --- attend ---------------------------------------------------------------
+
+def _naive_attend(q, k, v, mask):
+    scores = np.einsum("bhid,bhjd->bhij", q, k)
+    scores = np.where(mask, scores, -1e30)
+    scores = scores - scores.max(-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhij,bhjd->bhid", p, v)
+
+
+def test_attend_matches_naive():
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(2, 3, 10, 8).astype(np.float32) for _ in range(3))
+    mask = np.asarray(causal_mask(10))
+    got = np.asarray(attend(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, _naive_attend(q, k, v, mask), atol=1e-5)
+
+
+def test_attend_causality():
+    """Changing future tokens must not change past outputs."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 8, 4).astype(np.float32)
+    x2 = x.copy()
+    x2[:, :, -1] += 100.0
+    mask = jnp.asarray(np.asarray(causal_mask(8)))
+    a = np.asarray(attend(jnp.asarray(x), jnp.asarray(x), jnp.asarray(x), mask))
+    b = np.asarray(attend(jnp.asarray(x2), jnp.asarray(x2), jnp.asarray(x2), mask))
+    np.testing.assert_allclose(a[:, :, :-1], b[:, :, :-1], atol=1e-5)
+
+
+# --- token shift ----------------------------------------------------------
+
+def _oracle_shift(x, seq_len, fmap):
+    """Loop restatement of PreShiftToken's pad/chunk semantics."""
+    b, n, d = x.shape
+    img_seq_len = fmap * fmap
+    text_len = seq_len + 1 - img_seq_len
+    if n < text_len:
+        return x.copy()
+    out = np.zeros_like(x)
+    q = d // 4
+    for pos in range(n):
+        if pos < text_len:
+            src = pos - 1
+            if src >= 0:
+                out[:, pos, : d // 2] = x[:, src, : d // 2]
+            out[:, pos, d // 2 :] = x[:, pos, d // 2 :]
+        else:
+            ip = pos - text_len
+            h, w = divmod(ip, fmap)
+            # top quarter from the row above
+            if h > 0:
+                src = text_len + (h - 1) * fmap + w
+                if src < n:
+                    out[:, pos, :q] = x[:, src, :q]
+            # left quarter from the left neighbour
+            if w > 0:
+                src = text_len + h * fmap + (w - 1)
+                if src < n:
+                    out[:, pos, q : 2 * q] = x[:, src, q : 2 * q]
+            out[:, pos, 2 * q :] = x[:, pos, 2 * q :]
+    return out
+
+
+def test_token_shift_matches_oracle():
+    fmap = 4
+    seq_len = 8 + fmap * fmap  # text_seq_len 8
+    rng = np.random.RandomState(0)
+    for n in (seq_len, seq_len - 1, seq_len + 1 - fmap * fmap):
+        x = rng.randn(2, n, 8).astype(np.float32)
+        got = np.asarray(token_shift(jnp.asarray(x), seq_len, fmap))
+        np.testing.assert_allclose(got, _oracle_shift(x, seq_len, fmap), atol=1e-6)
+
+
+def test_token_shift_short_text_passthrough():
+    fmap = 4
+    seq_len = 8 + fmap * fmap
+    x = np.random.RandomState(0).randn(1, 5, 8).astype(np.float32)  # n < text_len
+    got = np.asarray(token_shift(jnp.asarray(x), seq_len, fmap))
+    np.testing.assert_array_equal(got, x)
