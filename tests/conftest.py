@@ -1,9 +1,17 @@
-"""Test harness: force a virtual 8-device CPU platform BEFORE jax imports so
-multi-chip sharding logic is exercised without TPU hardware (the JAX-native
-answer to testing multi-node without a cluster — see SURVEY.md §4)."""
+"""Test harness: force a virtual 8-device CPU platform BEFORE the backend
+initializes so multi-chip sharding logic is exercised without TPU hardware
+(the JAX-native answer to testing multi-node without a cluster — see
+SURVEY.md §4).  The environment may preset JAX_PLATFORMS (e.g. to a TPU
+tunnel) and pytest plugins may import jax early, so both the env vars and the
+live config are forced here."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

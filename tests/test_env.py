@@ -1,0 +1,4 @@
+def test_devices():
+    import jax
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.config.jax_default_matmul_precision == 'highest'
