@@ -1,0 +1,237 @@
+"""Trainable discrete VAE image tokenizer.
+
+Capability parity with the reference DiscreteVAE
+(/root/reference/dalle_pytorch/dalle_pytorch.py:101-268): conv encoder to a
+categorical distribution per latent cell, gumbel-softmax sampling against a
+codebook (optional straight-through and ReinMax second-order estimator),
+deconv decoder, MSE/smooth-L1 reconstruction loss plus weighted
+KL-to-uniform, per-channel input normalization, optional resnet stacks.
+
+TPU-native design: NHWC layout throughout (channels-last is the layout XLA
+tiles onto the MXU for convs), pure functions over a parameter pytree, an
+explicit PRNG key for the gumbel noise, and `temp` as a traced scalar so
+temperature annealing doesn't retrigger compilation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.core.module import (
+    conv2d,
+    conv2d_init,
+    conv2d_transpose,
+    conv2d_transpose_init,
+)
+from dalle_pytorch_tpu.core.rng import KeyChain
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteVAEConfig:
+    image_size: int = 256
+    num_tokens: int = 512
+    codebook_dim: int = 512
+    num_layers: int = 3
+    num_resnet_blocks: int = 0
+    hidden_dim: int = 64
+    channels: int = 3
+    smooth_l1_loss: bool = False
+    temperature: float = 0.9
+    straight_through: bool = False
+    reinmax: bool = False
+    kl_div_loss_weight: float = 0.0
+    # per-channel (means, stds); truncated to `channels`
+    normalization: Optional[Tuple[Tuple[float, ...], Tuple[float, ...]]] = (
+        (0.5, 0.5, 0.5, 0.0),
+        (0.5, 0.5, 0.5, 1.0),
+    )
+
+    def __post_init__(self):
+        assert math.log2(self.image_size).is_integer(), "image size must be a power of 2"
+        assert self.num_layers >= 1, "number of layers must be >= 1"
+
+    @property
+    def fmap_size(self) -> int:
+        return self.image_size // (2 ** self.num_layers)
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.fmap_size ** 2
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _res_block_init(keys: KeyChain, chan: int) -> dict:
+    return {
+        "c1": conv2d_init(keys.next(), chan, chan, 3),
+        "c2": conv2d_init(keys.next(), chan, chan, 3),
+        "c3": conv2d_init(keys.next(), chan, chan, 1),
+    }
+
+
+def _res_block(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    y = jax.nn.relu(conv2d(params["c1"], x, padding=1))
+    y = jax.nn.relu(conv2d(params["c2"], y, padding=1))
+    y = conv2d(params["c3"], y, padding=0)
+    return y + x
+
+
+def init_discrete_vae(key: jax.Array, cfg: DiscreteVAEConfig) -> dict:
+    keys = KeyChain(key)
+    has_res = cfg.num_resnet_blocks > 0
+    hdim = cfg.hidden_dim
+
+    enc_convs = []
+    in_chan = cfg.channels
+    for _ in range(cfg.num_layers):
+        enc_convs.append(conv2d_init(keys.next(), in_chan, hdim, 4))
+        in_chan = hdim
+
+    dec_deconvs = []
+    dec_in = cfg.codebook_dim if not has_res else hdim
+    for _ in range(cfg.num_layers):
+        dec_deconvs.append(conv2d_transpose_init(keys.next(), dec_in, hdim, 4))
+        dec_in = hdim
+
+    params = {
+        "codebook": {"table": jax.random.normal(keys.next(), (cfg.num_tokens, cfg.codebook_dim))},
+        "enc_convs": enc_convs,
+        "enc_res": [_res_block_init(keys, hdim) for _ in range(cfg.num_resnet_blocks)],
+        "enc_out": conv2d_init(keys.next(), hdim, cfg.num_tokens, 1),
+        "dec_deconvs": dec_deconvs,
+        "dec_res": [_res_block_init(keys, hdim) for _ in range(cfg.num_resnet_blocks)],
+        "dec_out": conv2d_init(keys.next(), hdim, cfg.channels, 1),
+    }
+    if has_res:
+        params["dec_in"] = conv2d_init(keys.next(), cfg.codebook_dim, hdim, 1)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# apply
+# ---------------------------------------------------------------------------
+
+def normalize_images(cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """images: (B, H, W, C) in [0, 1]."""
+    if cfg.normalization is None:
+        return images
+    means = jnp.asarray(cfg.normalization[0][: cfg.channels], images.dtype)
+    stds = jnp.asarray(cfg.normalization[1][: cfg.channels], images.dtype)
+    return (images - means) / stds
+
+
+def encode_logits(params: dict, cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """Normalized conv stack -> per-cell codebook logits (B, h, w, num_tokens)."""
+    x = normalize_images(cfg, images)
+    for conv in params["enc_convs"]:
+        x = jax.nn.relu(conv2d(conv, x, stride=2, padding=1))
+    for res in params["enc_res"]:
+        x = _res_block(res, x)
+    return conv2d(params["enc_out"], x, padding=0)
+
+
+def decode_embeddings(params: dict, cfg: DiscreteVAEConfig, z: jnp.ndarray) -> jnp.ndarray:
+    """(B, h, w, codebook_dim) -> (B, H, W, C) in normalized pixel space."""
+    x = z
+    if "dec_in" in params:
+        x = conv2d(params["dec_in"], x, padding=0)
+    for res in params["dec_res"]:
+        x = _res_block(res, x)
+    for deconv in params["dec_deconvs"]:
+        x = jax.nn.relu(conv2d_transpose(deconv, x, stride=2, kernel=4, torch_padding=1))
+    return conv2d(params["dec_out"], x, padding=0)
+
+
+def get_codebook_indices(params: dict, cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, C) raw pixels -> (B, image_seq_len) hard code indices."""
+    logits = encode_logits(params, cfg, images)
+    b = logits.shape[0]
+    return jnp.argmax(logits, axis=-1).reshape(b, -1)
+
+
+def decode_indices(params: dict, cfg: DiscreteVAEConfig, img_seq: jnp.ndarray) -> jnp.ndarray:
+    """(B, image_seq_len) code indices -> (B, H, W, C) images."""
+    b, n = img_seq.shape
+    hw = int(math.isqrt(n))
+    z = jnp.take(params["codebook"]["table"], img_seq, axis=0)
+    z = z.reshape(b, hw, hw, cfg.codebook_dim)
+    return decode_embeddings(params, cfg, z)
+
+
+def _gumbel_softmax(key, logits, tau, hard):
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, logits.shape, logits.dtype, 1e-20, 1.0) + 1e-20))
+    soft = jax.nn.softmax((logits + g) / tau, axis=-1)
+    if not hard:
+        return soft
+    one_hot = jax.nn.one_hot(jnp.argmax(soft, axis=-1), logits.shape[-1], dtype=soft.dtype)
+    return one_hot + soft - jax.lax.stop_gradient(soft)
+
+
+def forward(
+    params: dict,
+    cfg: DiscreteVAEConfig,
+    images: jnp.ndarray,
+    key: Optional[jax.Array] = None,
+    return_loss: bool = False,
+    return_recons: bool = False,
+    temp: Optional[jnp.ndarray] = None,
+):
+    """Training/reconstruction forward.  images: (B, H, W, C) in [0, 1]."""
+    assert images.shape[1] == images.shape[2] == cfg.image_size, (
+        f"input must have the correct image size {cfg.image_size}"
+    )
+    logits = encode_logits(params, cfg, images)
+    tau = cfg.temperature if temp is None else temp
+
+    assert key is not None, "gumbel sampling needs a PRNG key"
+    one_hot = _gumbel_softmax(key, logits, tau, hard=cfg.straight_through)
+
+    if cfg.straight_through and cfg.reinmax:
+        # ReinMax second-order estimator (algorithm 2 of arXiv:2304.08612),
+        # mirroring /root/reference/dalle_pytorch/dalle_pytorch.py:236-244
+        one_hot = jax.lax.stop_gradient(one_hot)
+        pi0 = jax.nn.softmax(logits, axis=-1)
+        pi1 = (one_hot + jax.nn.softmax(logits / tau, axis=-1)) / 2
+        pi1 = jax.nn.softmax(
+            jax.lax.stop_gradient(jnp.log(jnp.clip(pi1, 1e-20)) - logits) + logits, axis=-1
+        )
+        pi2 = 2 * pi1 - 0.5 * pi0
+        one_hot = pi2 - jax.lax.stop_gradient(pi2) + one_hot
+
+    sampled = jnp.einsum(
+        "bhwn,nd->bhwd", one_hot, params["codebook"]["table"], preferred_element_type=jnp.float32
+    ).astype(one_hot.dtype)
+    out = decode_embeddings(params, cfg, sampled)
+
+    if not return_loss:
+        return out
+
+    target = normalize_images(cfg, images)
+    if cfg.smooth_l1_loss:
+        diff = jnp.abs(target - out)
+        recon = jnp.mean(jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5))
+    else:
+        recon = jnp.mean((target - out) ** 2)
+
+    # KL(q || uniform), summed over cells and classes, averaged over batch —
+    # the reference's kl_div(log_uniform, log_qy, 'batchmean', log_target=True)
+    b = logits.shape[0]
+    flat = logits.reshape(b, -1, cfg.num_tokens)
+    log_qy = jax.nn.log_softmax(flat, axis=-1)
+    log_uniform = -jnp.log(jnp.asarray(cfg.num_tokens, jnp.float32))
+    qy = jnp.exp(log_qy)
+    kl = jnp.sum(qy * (log_qy - log_uniform)) / b
+
+    loss = recon + kl * cfg.kl_div_loss_weight
+    if not return_recons:
+        return loss
+    return loss, out
