@@ -1,0 +1,132 @@
+"""CLIP: contrastive text/image encoders for reranking generations.
+
+Capability parity with /root/reference/dalle_pytorch/dalle_pytorch.py:272-348:
+non-causal text transformer + ViT-style patch transformer, masked-mean text
+pooling, learned temperature, symmetric cross-entropy loss.  Images are NHWC.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.core.module import embedding_init, linear, linear_init
+from dalle_pytorch_tpu.core.rng import KeyChain
+from dalle_pytorch_tpu.models.transformer import TransformerConfig, apply_transformer, init_transformer
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+
+    def __post_init__(self):
+        assert self.visual_image_size % self.visual_patch_size == 0, (
+            "Image dimensions must be divisible by the patch size."
+        )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.visual_image_size // self.visual_patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.visual_patch_size ** 2
+
+    def text_transformer_config(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim_text, depth=self.text_enc_depth, seq_len=self.text_seq_len,
+            causal=False, heads=self.text_heads, rotary_emb=False,
+        )
+
+    def visual_transformer_config(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim_image, depth=self.visual_enc_depth, seq_len=self.num_patches,
+            causal=False, heads=self.visual_heads, rotary_emb=False,
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def init_clip(key: jax.Array, cfg: CLIPConfig) -> dict:
+    keys = KeyChain(key)
+    return {
+        "text_emb": embedding_init(keys.next(), cfg.num_text_tokens, cfg.dim_text),
+        "text_pos": embedding_init(keys.next(), cfg.text_seq_len, cfg.dim_text),
+        "text_transformer": init_transformer(keys.next(), cfg.text_transformer_config()),
+        "to_text_latent": linear_init(keys.next(), cfg.dim_text, cfg.dim_latent, bias=False),
+        "patch_emb": linear_init(keys.next(), cfg.patch_dim, cfg.dim_image),
+        "visual_pos": embedding_init(keys.next(), cfg.num_patches, cfg.dim_image),
+        "visual_transformer": init_transformer(keys.next(), cfg.visual_transformer_config()),
+        "to_visual_latent": linear_init(keys.next(), cfg.dim_image, cfg.dim_latent, bias=False),
+        "temperature": jnp.ones((), jnp.float32),
+    }
+
+
+def _patchify(cfg: CLIPConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """(b, H, W, C) -> (b, num_patches, patch_dim) with (p1, p2, c) flattening."""
+    b, H, W, C = images.shape
+    p = cfg.visual_patch_size
+    x = images.reshape(b, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (H // p) * (W // p), p * p * C)
+
+
+def encode_text(params: dict, cfg: CLIPConfig, text: jnp.ndarray, text_mask=None) -> jnp.ndarray:
+    emb = jnp.take(params["text_emb"]["table"], text, axis=0)
+    emb = emb + jnp.take(params["text_pos"]["table"], jnp.arange(text.shape[1]), axis=0)
+    enc = apply_transformer(params["text_transformer"], cfg.text_transformer_config(), emb, key_mask=text_mask)
+    if text_mask is not None:
+        m = text_mask[..., None].astype(enc.dtype)
+        latent = jnp.sum(enc * m, axis=1) / jnp.sum(m, axis=1)
+    else:
+        latent = jnp.mean(enc, axis=1)
+    latent = linear(params["to_text_latent"], latent)
+    return latent / jnp.linalg.norm(latent, axis=-1, keepdims=True)
+
+
+def encode_image(params: dict, cfg: CLIPConfig, images: jnp.ndarray) -> jnp.ndarray:
+    emb = linear(params["patch_emb"], _patchify(cfg, images))
+    emb = emb + jnp.take(params["visual_pos"]["table"], jnp.arange(emb.shape[1]), axis=0)
+    enc = apply_transformer(params["visual_transformer"], cfg.visual_transformer_config(), emb)
+    latent = linear(params["to_visual_latent"], jnp.mean(enc, axis=1))
+    return latent / jnp.linalg.norm(latent, axis=-1, keepdims=True)
+
+
+def forward(
+    params: dict,
+    cfg: CLIPConfig,
+    text: jnp.ndarray,
+    images: jnp.ndarray,
+    text_mask: Optional[jnp.ndarray] = None,
+    return_loss: bool = False,
+):
+    """Per-pair similarity scores (b,), or the symmetric contrastive loss."""
+    tl = encode_text(params, cfg, text, text_mask)
+    il = encode_image(params, cfg, images)
+    temp = jnp.exp(params["temperature"])
+
+    if not return_loss:
+        return jnp.einsum("nd,nd->n", tl, il) * temp
+
+    sim = jnp.einsum("id,jd->ij", tl, il) * temp
+    b = sim.shape[0]
+    labels = jnp.arange(b)
+    logp_t = jax.nn.log_softmax(sim, axis=-1)
+    logp_i = jax.nn.log_softmax(sim.T, axis=-1)
+    ce_t = -jnp.mean(jnp.take_along_axis(logp_t, labels[:, None], axis=-1))
+    ce_i = -jnp.mean(jnp.take_along_axis(logp_i, labels[:, None], axis=-1))
+    return (ce_t + ce_i) / 2

@@ -1,0 +1,275 @@
+"""The text→image autoregressive DALL-E model.
+
+Capability parity with /root/reference/dalle_pytorch/dalle_pytorch.py:352-671:
+joint text+image vocabulary with per-position unique padding tokens, <bos>
+prepend, axial/learned or rotary positions, logits masking so text positions
+predict text and image positions predict image, the (text + 7*img)/8 weighted
+CE loss, the `stable` embedding-blend + DivideMax tricks, and optional tied
+input/output embeddings.
+
+The model is a pure function over a parameter pytree and operates on image
+*codes* — the frozen VAE that turns pixels into codes is composed by the
+caller (training/api layers), removing the reference's model→distributed
+coupling (SURVEY.md §1)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.core.module import embedding_init, layer_norm, layer_norm_init, linear, linear_init
+from dalle_pytorch_tpu.core.rng import KeyChain
+from dalle_pytorch_tpu.models.transformer import TransformerConfig, apply_transformer, init_transformer
+from dalle_pytorch_tpu.ops.sampling import prob_mask_like
+from dalle_pytorch_tpu.ops.stable import divide_max
+
+
+@dataclasses.dataclass(frozen=True)
+class DALLEConfig:
+    dim: int
+    depth: int
+    num_text_tokens: int = 10000  # raw text vocab; per-position pad ids are reserved on top
+    text_seq_len: int = 256
+    heads: int = 8
+    dim_head: int = 64
+    reversible: bool = False
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: Tuple[str, ...] = ("full",)
+    loss_img_weight: float = 7.0
+    stable: bool = False
+    sandwich_norm: bool = False
+    shift_tokens: bool = True
+    rotary_emb: bool = True
+    shared_attn_ids: Optional[Tuple[int, ...]] = None
+    shared_ff_ids: Optional[Tuple[int, ...]] = None
+    share_input_output_emb: bool = False
+    execution: Optional[str] = None  # None -> 'reversible' if reversible else 'sequential'
+    # image side, derived from the VAE that produced the codes
+    num_image_tokens: int = 512
+    image_fmap_size: int = 32
+    # sparse pattern knobs
+    conv_kernel_size: int = 5
+    conv_dilation: int = 1
+    sparse_block_size: int = 16
+
+    # -- derived ----------------------------------------------------------
+    @property
+    def num_text_tokens_padded(self) -> int:
+        return self.num_text_tokens + self.text_seq_len
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_fmap_size ** 2
+
+    @property
+    def total_seq_len(self) -> int:
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        return self.num_text_tokens_padded + self.num_image_tokens
+
+    @property
+    def resolved_execution(self) -> str:
+        if self.execution is not None:
+            return self.execution
+        return "reversible" if self.reversible else "sequential"
+
+    def transformer_config(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim,
+            depth=self.depth,
+            seq_len=self.total_seq_len,
+            causal=True,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            attn_types=self.attn_types,
+            image_fmap_size=self.image_fmap_size,
+            stable=self.stable,
+            sandwich_norm=self.sandwich_norm,
+            shift_tokens=self.shift_tokens,
+            rotary_emb=self.rotary_emb,
+            shared_attn_ids=self.shared_attn_ids,
+            shared_ff_ids=self.shared_ff_ids,
+            execution=self.resolved_execution,
+            conv_kernel_size=self.conv_kernel_size,
+            conv_dilation=self.conv_dilation,
+            sparse_block_size=self.sparse_block_size,
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_vae(cls, vae_cfg, **kwargs) -> "DALLEConfig":
+        """Derive the image-side fields from a DiscreteVAEConfig (or any object
+        with num_tokens / image_size / num_layers)."""
+        fmap = vae_cfg.image_size // (2 ** vae_cfg.num_layers)
+        return cls(num_image_tokens=vae_cfg.num_tokens, image_fmap_size=fmap, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_dalle(key: jax.Array, cfg: DALLEConfig) -> dict:
+    keys = KeyChain(key)
+    params = {
+        "transformer": init_transformer(keys.next(), cfg.transformer_config()),
+        "logits_norm": layer_norm_init(cfg.dim),
+        "logits_linear": linear_init(keys.next(), cfg.dim, cfg.total_tokens),
+    }
+    if not cfg.share_input_output_emb:
+        params["text_emb"] = embedding_init(keys.next(), cfg.num_text_tokens_padded, cfg.dim)
+        params["image_emb"] = embedding_init(keys.next(), cfg.num_image_tokens, cfg.dim)
+    if not cfg.rotary_emb:
+        params["text_pos"] = embedding_init(keys.next(), cfg.text_seq_len + 1, cfg.dim)
+        # axial positional embedding: summed per-row and per-column tables
+        params["image_pos_h"] = embedding_init(keys.next(), cfg.image_fmap_size, cfg.dim)
+        params["image_pos_w"] = embedding_init(keys.next(), cfg.image_fmap_size, cfg.dim)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# embedding helpers (shared with the sampler)
+# ---------------------------------------------------------------------------
+
+def _text_table(params: dict, cfg: DALLEConfig) -> jnp.ndarray:
+    if cfg.share_input_output_emb:
+        return params["logits_linear"]["w"][:, : cfg.num_text_tokens_padded].T
+    return params["text_emb"]["table"]
+
+
+def _image_table(params: dict, cfg: DALLEConfig) -> jnp.ndarray:
+    if cfg.share_input_output_emb:
+        return params["logits_linear"]["w"][:, cfg.num_text_tokens_padded :].T
+    return params["image_emb"]["table"]
+
+
+def remap_and_bos(cfg: DALLEConfig, text: jnp.ndarray) -> jnp.ndarray:
+    """Give padding (id 0) a unique per-position id, then prepend <bos>=0."""
+    b = text.shape[0]
+    text_range = jnp.arange(cfg.text_seq_len) + (cfg.num_text_tokens_padded - cfg.text_seq_len)
+    text = jnp.where(text == 0, text_range, text)
+    return jnp.concatenate([jnp.zeros((b, 1), text.dtype), text], axis=1)
+
+
+def embed_text_ids(params: dict, cfg: DALLEConfig, text_ids: jnp.ndarray) -> jnp.ndarray:
+    """text_ids: (b, n) post-remap ids incl. bos, positions 0..n-1."""
+    emb = jnp.take(_text_table(params, cfg), text_ids, axis=0)
+    if not cfg.rotary_emb:
+        pos = jnp.take(params["text_pos"]["table"], jnp.arange(text_ids.shape[1]), axis=0)
+        emb = emb + pos
+    return emb
+
+
+def image_pos_table(params: dict, cfg: DALLEConfig) -> Optional[jnp.ndarray]:
+    """(image_seq_len, dim) axial positional embeddings, or None under rotary."""
+    if cfg.rotary_emb:
+        return None
+    fmap = cfg.image_fmap_size
+    h = jnp.repeat(params["image_pos_h"]["table"], fmap, axis=0)
+    w = jnp.tile(params["image_pos_w"]["table"], (fmap, 1))
+    return h + w
+
+
+def embed_image_codes(params: dict, cfg: DALLEConfig, codes: jnp.ndarray, start: int = 0) -> jnp.ndarray:
+    """codes: (b, m) image code ids occupying raster positions start..start+m-1."""
+    emb = jnp.take(_image_table(params, cfg), codes, axis=0)
+    pos = image_pos_table(params, cfg)
+    if pos is not None:
+        emb = emb + jax.lax.dynamic_slice(pos, (start, 0), (codes.shape[1], pos.shape[1]))
+    return emb
+
+
+def logits_mask_slice(cfg: DALLEConfig, n: int) -> jnp.ndarray:
+    """(n, total_tokens) bool; True = FORBIDDEN (matches the reference's
+    masked_fill semantics at dalle_pytorch.py:450-455)."""
+    seq_range = jnp.arange(n)[:, None]
+    logits_range = jnp.arange(cfg.total_tokens)[None, :]
+    return ((seq_range >= cfg.text_seq_len) & (logits_range < cfg.num_text_tokens_padded)) | (
+        (seq_range < cfg.text_seq_len) & (logits_range >= cfg.num_text_tokens_padded)
+    )
+
+
+def to_logits(params: dict, cfg: DALLEConfig, x: jnp.ndarray) -> jnp.ndarray:
+    return linear(params["logits_linear"], layer_norm(params["logits_norm"], x))
+
+
+# ---------------------------------------------------------------------------
+# forward / loss
+# ---------------------------------------------------------------------------
+
+def forward(
+    params: dict,
+    cfg: DALLEConfig,
+    text: jnp.ndarray,
+    image_codes: Optional[jnp.ndarray] = None,
+    return_loss: bool = False,
+    null_cond_prob: float = 0.0,
+    key: Optional[jax.Array] = None,
+):
+    """Training/scoring forward.
+
+    text: (b, text_seq_len) token ids with 0 = padding.
+    image_codes: (b, image_seq_len) VAE code indices (callers with raw pixels
+    tokenize through the frozen VAE first).
+    Returns logits (b, n, total_tokens) or the weighted CE loss."""
+    assert text.shape[-1] == cfg.text_seq_len, (
+        f"text length {text.shape[-1]} != text_seq_len {cfg.text_seq_len}"
+    )
+    drop_key = None
+    if null_cond_prob > 0.0:
+        assert key is not None, "null_cond_prob requires a PRNG key"
+        key, null_key = jax.random.split(key)
+        null_mask = prob_mask_like(null_key, (text.shape[0],), null_cond_prob)
+        text = text * (~null_mask)[:, None]
+    if key is not None:
+        drop_key = key
+
+    text_ids = remap_and_bos(cfg, text)
+    tokens = embed_text_ids(params, cfg, text_ids)
+
+    if image_codes is not None and image_codes.size > 0:
+        img_emb = embed_image_codes(params, cfg, image_codes)
+        tokens = jnp.concatenate([tokens, img_emb], axis=1)
+
+    # drop the final token when the sequence overruns total_seq_len (it has
+    # nothing left to predict)
+    if tokens.shape[1] > cfg.total_seq_len:
+        tokens = tokens[:, : cfg.total_seq_len]
+    n = tokens.shape[1]
+
+    if cfg.stable:
+        alpha = 0.1
+        tokens = tokens * alpha + jax.lax.stop_gradient(tokens) * (1 - alpha)
+
+    out = apply_transformer(params["transformer"], cfg.transformer_config(), tokens, dropout_key=drop_key)
+
+    if cfg.stable:
+        out = divide_max(out)
+
+    logits = to_logits(params, cfg, out)
+    logits = jnp.where(
+        logits_mask_slice(cfg, n)[None], jnp.finfo(logits.dtype).min, logits
+    )
+
+    if not return_loss:
+        return logits
+
+    assert image_codes is not None, "when training, image codes must be supplied"
+    labels = jnp.concatenate(
+        [text_ids[:, 1:], image_codes + cfg.num_text_tokens_padded], axis=1
+    )
+    assert labels.shape[1] == cfg.total_seq_len
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    loss_text = -jnp.mean(token_ll[:, : cfg.text_seq_len])
+    loss_img = -jnp.mean(token_ll[:, cfg.text_seq_len :])
+    return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)

@@ -71,6 +71,63 @@ def _pattern_mask_np(
     return mask[:seq_len, :seq_len]
 
 
+@lru_cache(maxsize=16)
+def _block_sparse_mask_np(
+    seq_len: int,
+    image_fmap_size: int,
+    block_size: int,
+    num_random_blocks: int,
+    local_window_blocks: int,
+    seed: int,
+) -> np.ndarray:
+    """Block-sparse layout with the semantics of DeepSpeed's
+    VariableSparsityConfig as used by the reference
+    (/root/reference/dalle_pytorch/attention.py:349-365): fixed block size,
+    a local window of preceding blocks, text-covering global blocks (global in
+    both row and column), and per-query-block random blocks; unidirectional
+    (lower-triangular at block granularity).  The random choices are seeded
+    for reproducibility (the reference's are not — layouts are drawn once per
+    module instantiation)."""
+    img_seq_len = image_fmap_size ** 2
+    text_len = seq_len + 1 - img_seq_len
+    nb = -(-seq_len // block_size)
+    num_global = -(-text_len // block_size)
+
+    layout = np.zeros((nb, nb), dtype=bool)
+    for qb in range(nb):
+        lo = max(0, qb - local_window_blocks + 1)
+        layout[qb, lo : qb + 1] = True  # local window
+    layout[:, :num_global] = True  # global text blocks as keys
+    layout[:num_global, :] = True  # global text blocks as queries
+    rng = np.random.RandomState(seed)
+    for qb in range(nb):
+        if qb > 0 and num_random_blocks > 0:
+            picks = rng.randint(0, qb + 1, size=num_random_blocks)
+            layout[qb, picks] = True
+    # unidirectional: no block above the diagonal
+    layout &= np.tril(np.ones((nb, nb), dtype=bool))
+
+    mask = np.kron(layout, np.ones((block_size, block_size), dtype=bool))
+    return mask[:seq_len, :seq_len]
+
+
+def build_block_sparse_mask(
+    seq_len: int,
+    image_fmap_size: int,
+    block_size: int = 16,
+    num_random_blocks: int | None = None,
+    local_window_blocks: int = 4,
+    seed: int = 0,
+) -> jnp.ndarray:
+    if num_random_blocks is None:
+        num_random_blocks = seq_len // block_size // 4
+    return jnp.asarray(
+        _block_sparse_mask_np(
+            seq_len, image_fmap_size, block_size, num_random_blocks, local_window_blocks, seed
+        )
+    )
+
+
 def build_pattern_mask(
     attn_type: str,
     seq_len: int,

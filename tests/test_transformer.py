@@ -1,0 +1,219 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models.transformer import (
+    TransformerConfig,
+    apply_transformer,
+    decode_step,
+    derive_layer_specs,
+    init_cache,
+    init_transformer,
+    prefill,
+)
+
+FMAP = 4
+TEXT_SEQ = 8
+SEQ = TEXT_SEQ + FMAP * FMAP  # 24; layout text_len = 9
+
+
+def cfg_for(**kw):
+    base = dict(
+        dim=32,
+        depth=2,
+        seq_len=SEQ,
+        heads=2,
+        dim_head=8,
+        image_fmap_size=FMAP,
+        attn_types=("full",),
+        rotary_emb=True,
+        shift_tokens=False,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def make(cfg, seed=0):
+    params = init_transformer(jax.random.PRNGKey(seed), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (2, cfg.seq_len, cfg.dim)) * 0.1
+    return params, x
+
+
+def test_output_shape_and_finite():
+    cfg = cfg_for()
+    params, x = make(cfg)
+    y = apply_transformer(params, cfg, x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_causality_full():
+    cfg = cfg_for(shift_tokens=True)
+    params, x = make(cfg)
+    x2 = x.at[:, -1, 0].add(10.0)
+    a = np.asarray(apply_transformer(params, cfg, x))
+    b = np.asarray(apply_transformer(params, cfg, x2))
+    np.testing.assert_allclose(a[:, :-1], b[:, :-1], atol=1e-5)
+    assert np.abs(a[:, -1] - b[:, -1]).max() > 1e-3
+
+
+@pytest.mark.parametrize("attn_type", ["axial_row", "axial_col", "conv_like", "sparse"])
+def test_variant_runs_and_is_causal(attn_type):
+    cfg = cfg_for(attn_types=(attn_type,))
+    params, x = make(cfg)
+    y = apply_transformer(params, cfg, x)
+    assert np.isfinite(np.asarray(y)).all()
+    x2 = x.at[:, 12, 0].add(10.0)
+    y2 = apply_transformer(params, cfg, x2)
+    np.testing.assert_allclose(np.asarray(y)[:, :12], np.asarray(y2)[:, :12], atol=1e-5)
+
+
+def test_axial_row_sparsity_behavior():
+    """An image token's output must ignore image tokens in other rows (but
+    see all text)."""
+    cfg = cfg_for(attn_types=("axial_row",), depth=1)
+    params, x = make(cfg)
+    text_len = cfg.text_len  # 9
+    # query: last image token of row 2 -> positions text_len+8..text_len+11 are row 2
+    q_pos = text_len + 2 * FMAP + 3
+    # perturb an EARLIER row-1 image token (causally before q_pos, different row)
+    p_pos = text_len + 1 * FMAP + 1
+    x2 = x.at[:, p_pos, 0].add(10.0)
+    a = np.asarray(apply_transformer(params, cfg, x))
+    b = np.asarray(apply_transformer(params, cfg, x2))
+    np.testing.assert_allclose(a[:, q_pos], b[:, q_pos], atol=1e-5)
+    # sanity: a same-row earlier token DOES affect it
+    x3 = x.at[:, text_len + 2 * FMAP + 1, 0].add(10.0)
+    c = np.asarray(apply_transformer(params, cfg, x3))
+    assert np.abs(a[:, q_pos] - c[:, q_pos]).max() > 1e-4
+
+
+def test_weight_sharing_reduces_params():
+    cfg_shared = cfg_for(depth=4, shared_attn_ids=(0, 0, 1, 1), shared_ff_ids=(0, 1, 0, 1))
+    params = init_transformer(jax.random.PRNGKey(0), cfg_shared)
+    assert set(params["shared_attn"].keys()) == {"0", "1"}
+    assert set(params["shared_ff"].keys()) == {"0", "1"}
+    assert len(params["layers"]) == 4
+
+
+def test_shared_id_type_mismatch_raises():
+    cfg = cfg_for(depth=2, attn_types=("full", "axial_row"), shared_attn_ids=(0, 0))
+    with pytest.raises(ValueError, match="attn_types do not match"):
+        derive_layer_specs(cfg)
+
+
+def test_remat_matches_sequential():
+    cfg_seq = cfg_for(shift_tokens=True)
+    cfg_remat = cfg_for(shift_tokens=True, execution="remat")
+    params, x = make(cfg_seq)
+    a = np.asarray(apply_transformer(params, cfg_seq, x))
+    b = np.asarray(apply_transformer(params, cfg_remat, x))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+    ga = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_seq, x) ** 2))(params)
+    gb = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_remat, x) ** 2))(params)
+    for la, lb in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+
+def test_reversible_grads_match_naive():
+    """The custom_vjp reversible engine must agree with plain autodiff through
+    the same stream equations."""
+    from dalle_pytorch_tpu.models.transformer import _branch, _pattern_for, transformer_rotary
+
+    cfg = cfg_for(execution="reversible", shift_tokens=True, depth=3)
+    params, x = make(cfg)
+    specs = derive_layer_specs(cfg)
+    rotary = transformer_rotary(cfg)
+    patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
+
+    def naive(params, x):
+        x1 = x2 = x
+        for s in specs:
+            x1 = x1 + _branch(params, cfg, s, x2, "attn", rotary, patterns[s.attn_type], None, None)
+            x2 = x2 + _branch(params, cfg, s, x1, "ff", rotary, patterns[s.attn_type], None, None)
+        return (x1 + x2) / 2
+
+    y_rev = apply_transformer(params, cfg, x)
+    y_naive = naive(params, x)
+    np.testing.assert_allclose(np.asarray(y_rev), np.asarray(y_naive), atol=1e-5)
+
+    g_rev = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg, x) ** 2))(params)
+    g_naive = jax.grad(lambda p: jnp.sum(naive(p, x) ** 2))(params)
+    for la, lb in zip(jax.tree_util.tree_leaves(g_rev), jax.tree_util.tree_leaves(g_naive)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4)
+
+
+def test_reversible_input_gradient():
+    cfg = cfg_for(execution="reversible")
+    params, x = make(cfg)
+    g = jax.grad(lambda xx: jnp.sum(apply_transformer(params, cfg, xx) ** 2))(x)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).max() > 0
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(attn_types=("full",), shift_tokens=True),
+        dict(attn_types=("axial_row", "axial_col"), shift_tokens=True),
+        dict(attn_types=("conv_like",), shift_tokens=False),
+        dict(attn_types=("full",), shift_tokens=True, sandwich_norm=True, stable=True),
+        dict(attn_types=("full",), shift_tokens=True, execution="reversible"),
+    ],
+)
+def test_cached_decode_matches_full_forward(kw):
+    """Prefill text, then decode image positions one token at a time; outputs
+    must match the uncached full-sequence forward at every position."""
+    cfg = cfg_for(**kw)
+    params, x = make(cfg)
+    text_len = cfg.text_len
+
+    full = np.asarray(apply_transformer(params, cfg, x))
+
+    cache = init_cache(cfg, batch=2)
+    out_pre, cache = prefill(params, cfg, x[:, :text_len], cache)
+    np.testing.assert_allclose(np.asarray(out_pre), full[:, :text_len], atol=1e-4)
+
+    for pos in range(text_len, cfg.seq_len):
+        out_tok, cache = decode_step(params, cfg, x[:, pos : pos + 1], cache)
+        np.testing.assert_allclose(
+            np.asarray(out_tok)[:, 0], full[:, pos], atol=1e-4,
+            err_msg=f"mismatch at position {pos} for {kw}",
+        )
+
+
+def test_prefill_with_image_tokens():
+    """Priming: prefill past the text boundary, then decode the rest."""
+    cfg = cfg_for(shift_tokens=True)
+    params, x = make(cfg)
+    n_pre = cfg.text_len + 6  # 6 primed image tokens (> fmap to wrap the ring)
+    full = np.asarray(apply_transformer(params, cfg, x))
+
+    cache = init_cache(cfg, batch=2)
+    out_pre, cache = prefill(params, cfg, x[:, :n_pre], cache)
+    np.testing.assert_allclose(np.asarray(out_pre), full[:, :n_pre], atol=1e-4)
+    for pos in range(n_pre, cfg.seq_len):
+        out_tok, cache = decode_step(params, cfg, x[:, pos : pos + 1], cache)
+        np.testing.assert_allclose(np.asarray(out_tok)[:, 0], full[:, pos], atol=1e-4)
+
+
+def test_non_causal_mode():
+    cfg = cfg_for(causal=False, rotary_emb=False, image_fmap_size=None, shift_tokens=False)
+    params, x = make(cfg)
+    y = apply_transformer(params, cfg, x)
+    assert np.isfinite(np.asarray(y)).all()
+    # non-causal: last-token perturbation affects earlier outputs
+    y2 = apply_transformer(params, cfg, x.at[:, -1, 0].add(10.0))
+    assert np.abs(np.asarray(y)[:, 0] - np.asarray(y2)[:, 0]).max() > 1e-4
+
+
+def test_key_padding_mask():
+    cfg = cfg_for(causal=False, rotary_emb=False, image_fmap_size=None)
+    params, x = make(cfg)
+    km = jnp.ones((2, cfg.seq_len), bool).at[:, -1].set(False)
+    a = apply_transformer(params, cfg, x, key_mask=km)
+    b = apply_transformer(params, cfg, x.at[:, -1, 0].add(10.0), key_mask=km)
+    # masked-out key may not influence other positions
+    np.testing.assert_allclose(np.asarray(a)[:, :-1], np.asarray(b)[:, :-1], atol=1e-5)
