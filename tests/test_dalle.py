@@ -1,0 +1,178 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import clip as clip_mod
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32,
+        depth=2,
+        num_text_tokens=64,
+        text_seq_len=8,
+        heads=2,
+        dim_head=8,
+        num_image_tokens=32,
+        image_fmap_size=4,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def data(cfg, seed=0):
+    kt, ki = jax.random.split(jax.random.PRNGKey(seed))
+    text = jax.random.randint(kt, (2, cfg.text_seq_len), 0, cfg.num_text_tokens)
+    codes = jax.random.randint(ki, (2, cfg.image_seq_len), 0, cfg.num_image_tokens)
+    return text, codes
+
+
+def test_forward_logits_shape_and_mask():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text, codes = data(cfg)
+    logits = dalle_mod.forward(params, cfg, text, codes)
+    assert logits.shape == (2, cfg.total_seq_len, cfg.total_tokens)
+    arr = np.asarray(logits)
+    neg = np.finfo(np.float32).min
+    # text positions may only produce text tokens, image positions image tokens
+    assert (arr[:, : cfg.text_seq_len, cfg.num_text_tokens_padded :] == neg).all()
+    assert (arr[:, cfg.text_seq_len :, : cfg.num_text_tokens_padded] == neg).all()
+    assert (arr[:, : cfg.text_seq_len, : cfg.num_text_tokens_padded] > neg).all()
+
+
+def test_loss_finite_and_differentiable():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text, codes = data(cfg)
+
+    def loss_fn(p):
+        return dalle_mod.forward(p, cfg, text, codes, return_loss=True)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_loss_weighting():
+    """loss = (loss_text + w * loss_img) / (w + 1); with w=0 only text counts."""
+    cfg1 = tiny_cfg(loss_img_weight=7)
+    cfg0 = tiny_cfg(loss_img_weight=0)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg1)
+    text, codes = data(cfg1)
+    l1 = float(dalle_mod.forward(params, cfg1, text, codes, return_loss=True))
+    l0 = float(dalle_mod.forward(params, cfg0, text, codes, return_loss=True))
+    assert l1 != pytest.approx(l0)
+
+
+def test_pad_remap_unique():
+    cfg = tiny_cfg()
+    text = jnp.zeros((1, cfg.text_seq_len), jnp.int32)
+    ids = dalle_mod.remap_and_bos(cfg, text)
+    arr = np.asarray(ids[0])
+    assert arr[0] == 0  # bos
+    # all-pad text becomes unique per-position ids at the top of the text vocab
+    expected = np.arange(cfg.text_seq_len) + (cfg.num_text_tokens_padded - cfg.text_seq_len)
+    np.testing.assert_array_equal(arr[1:], expected)
+
+
+def test_null_cond_prob_zeroes_text():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text, codes = data(cfg)
+    a = dalle_mod.forward(params, cfg, text, codes, null_cond_prob=1.0, key=jax.random.PRNGKey(1))
+    b = dalle_mod.forward(params, cfg, jnp.zeros_like(text), codes)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_share_input_output_emb():
+    cfg = tiny_cfg(share_input_output_emb=True)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    assert "text_emb" not in params and "image_emb" not in params
+    text, codes = data(cfg)
+    loss = dalle_mod.forward(params, cfg, text, codes, return_loss=True)
+    assert np.isfinite(float(loss))
+
+
+def test_learned_positions_mode():
+    cfg = tiny_cfg(rotary_emb=False)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    assert "text_pos" in params and "image_pos_h" in params
+    text, codes = data(cfg)
+    loss = dalle_mod.forward(params, cfg, text, codes, return_loss=True)
+    assert np.isfinite(float(loss))
+
+
+def test_stable_mode():
+    cfg = tiny_cfg(stable=True)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text, codes = data(cfg)
+    loss = dalle_mod.forward(params, cfg, text, codes, return_loss=True)
+    assert np.isfinite(float(loss))
+
+
+def test_from_vae_derivation():
+    from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+
+    vcfg = DiscreteVAEConfig(image_size=16, num_tokens=32, num_layers=2)
+    cfg = DALLEConfig.from_vae(vcfg, dim=32, depth=1, num_text_tokens=64, text_seq_len=8)
+    assert cfg.image_fmap_size == 4
+    assert cfg.num_image_tokens == 32
+    assert cfg.image_seq_len == 16
+
+
+def test_text_image_overfit():
+    """End-to-end: a tiny DALLE memorizes one (text, codes) pair."""
+    import optax
+
+    cfg = tiny_cfg(depth=2)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text, codes = data(cfg)
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(
+            lambda p: dalle_mod.forward(p, cfg, text, codes, return_loss=True)
+        )(params)
+        up, state = opt.update(g, state)
+        return optax.apply_updates(params, up), state, loss
+
+    first = None
+    for _ in range(120):
+        params, state, loss = step(params, state)
+        first = float(loss) if first is None else first
+    assert float(loss) < first * 0.5
+
+
+# --- CLIP -----------------------------------------------------------------
+
+def clip_cfg():
+    return clip_mod.CLIPConfig(
+        dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=64,
+        text_enc_depth=1, text_seq_len=8, text_heads=2,
+        visual_enc_depth=1, visual_heads=2, visual_image_size=16,
+        visual_patch_size=8, channels=3,
+    )
+
+
+def test_clip_scores_and_loss():
+    cfg = clip_cfg()
+    params = clip_mod.init_clip(jax.random.PRNGKey(0), cfg)
+    text = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 64)
+    images = jax.random.uniform(jax.random.PRNGKey(2), (4, 16, 16, 3))
+    mask = jnp.ones((4, 8), bool)
+
+    scores = clip_mod.forward(params, cfg, text, images, text_mask=mask)
+    assert scores.shape == (4,)
+
+    loss, grads = jax.value_and_grad(
+        lambda p: clip_mod.forward(p, cfg, text, images, text_mask=mask, return_loss=True)
+    )(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree_util.tree_leaves(grads))
+    assert np.abs(np.asarray(grads["temperature"])).max() > 0
