@@ -1,0 +1,158 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts, sample_image_codes
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32,
+        depth=2,
+        num_text_tokens=64,
+        text_seq_len=8,
+        heads=2,
+        dim_head=8,
+        num_image_tokens=32,
+        image_fmap_size=4,
+        shift_tokens=True,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def setup(cfg, seed=0):
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(seed), cfg)
+    text = jax.random.randint(jax.random.PRNGKey(seed + 1), (2, cfg.text_seq_len), 1, cfg.num_text_tokens)
+    return params, text
+
+
+def greedy_oracle(params, cfg, text):
+    """Uncached full-forward greedy decoding, the reference's loop structure
+    (dalle_pytorch.py:539-551) with argmax sampling."""
+    b = text.shape[0]
+    codes = jnp.zeros((b, 0), jnp.int32)
+    for i in range(cfg.image_seq_len):
+        logits = dalle_mod.forward(params, cfg, text, codes if i > 0 else None)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32) - cfg.num_text_tokens_padded
+        codes = jnp.concatenate([codes, nxt[:, None]], axis=1)
+    return np.asarray(codes)
+
+
+@pytest.mark.parametrize("kw", [dict(), dict(attn_types=("axial_row", "conv_like")), dict(execution="reversible")])
+def test_greedy_sampling_matches_uncached_oracle(kw):
+    cfg = tiny_cfg(**kw)
+    params, text = setup(cfg)
+    want = greedy_oracle(params, cfg, text)
+    got = np.asarray(
+        sample_image_codes(
+            params, cfg, text, jax.random.PRNGKey(9), filter_thres=0.97, temperature=1e-6
+        )
+    )
+    # filter_thres=0.97 keeps k=3 logits; with temperature→0 this is argmax
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sampling_valid_range_and_determinism():
+    cfg = tiny_cfg()
+    params, text = setup(cfg)
+    a = np.asarray(sample_image_codes(params, cfg, text, jax.random.PRNGKey(0)))
+    b = np.asarray(sample_image_codes(params, cfg, text, jax.random.PRNGKey(0)))
+    c = np.asarray(sample_image_codes(params, cfg, text, jax.random.PRNGKey(1)))
+    assert a.shape == (2, cfg.image_seq_len)
+    assert (a >= 0).all() and (a < cfg.num_image_tokens).all()
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+
+
+def test_cond_scale_runs():
+    cfg = tiny_cfg()
+    params, text = setup(cfg)
+    out = sample_image_codes(params, cfg, text, jax.random.PRNGKey(0), cond_scale=3.0)
+    assert np.asarray(out).shape == (2, cfg.image_seq_len)
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_priming_preserves_primer():
+    cfg = tiny_cfg()
+    params, text = setup(cfg)
+    primer = jax.random.randint(jax.random.PRNGKey(5), (2, 7), 0, cfg.num_image_tokens)
+    out = np.asarray(
+        sample_image_codes(
+            params, cfg, text, jax.random.PRNGKey(0), primer_codes=primer, prime_len=7
+        )
+    )
+    assert out.shape == (2, cfg.image_seq_len)
+    np.testing.assert_array_equal(out[:, :7], np.asarray(primer))
+
+
+def test_primed_greedy_matches_oracle():
+    """Priming must continue exactly the chain the oracle produces."""
+    cfg = tiny_cfg()
+    params, text = setup(cfg)
+    want = greedy_oracle(params, cfg, text)
+    primer = jnp.asarray(want[:, :6])
+    got = np.asarray(
+        sample_image_codes(
+            params, cfg, text, jax.random.PRNGKey(0),
+            filter_thres=0.97, temperature=1e-6, primer_codes=primer, prime_len=6,
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_images_end_to_end():
+    vcfg = vae_mod.DiscreteVAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2, hidden_dim=8)
+    vparams = vae_mod.init_discrete_vae(jax.random.PRNGKey(0), vcfg)
+    cfg = DALLEConfig.from_vae(vcfg, dim=32, depth=1, num_text_tokens=64, text_seq_len=8, heads=2, dim_head=8)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(1), cfg)
+    text = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 1, 64)
+
+    images = generate_images(params, cfg, vparams, vcfg, text, jax.random.PRNGKey(3))
+    assert images.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(images)).all()
+
+    # with raw-image priming
+    img = jax.random.uniform(jax.random.PRNGKey(4), (2, 16, 16, 3))
+    images2 = generate_images(params, cfg, vparams, vcfg, text, jax.random.PRNGKey(3), img=img)
+    assert images2.shape == (2, 16, 16, 3)
+
+
+def test_generate_images_with_clip_rerank():
+    from dalle_pytorch_tpu.models import clip as clip_mod
+
+    vcfg = vae_mod.DiscreteVAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2, hidden_dim=8)
+    vparams = vae_mod.init_discrete_vae(jax.random.PRNGKey(0), vcfg)
+    cfg = DALLEConfig.from_vae(vcfg, dim=32, depth=1, num_text_tokens=64, text_seq_len=8, heads=2, dim_head=8)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(1), cfg)
+    ccfg = clip_mod.CLIPConfig(
+        dim_text=16, dim_image=16, dim_latent=16, num_text_tokens=64 + 8,
+        text_enc_depth=1, text_seq_len=8, text_heads=2, visual_enc_depth=1,
+        visual_heads=2, visual_image_size=16, visual_patch_size=8,
+    )
+    cparams = clip_mod.init_clip(jax.random.PRNGKey(2), ccfg)
+    text = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 1, 64)
+
+    images, scores = generate_images(
+        params, cfg, vparams, vcfg, text, jax.random.PRNGKey(4),
+        clip_params=cparams, clip_cfg=ccfg,
+    )
+    assert images.shape == (2, 16, 16, 3)
+    assert scores.shape == (2,)
+
+
+def test_generate_texts():
+    cfg = tiny_cfg()
+    params, _ = setup(cfg)
+    prompt = jnp.asarray([[5, 9]], jnp.int32)
+    out = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(0), text=prompt))
+    assert out.shape == (1, cfg.text_seq_len)
+    np.testing.assert_array_equal(out[:, :2], np.asarray(prompt))
+    assert (out < cfg.num_text_tokens_padded).all()
+
+    out_default = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(0)))
+    assert out_default.shape == (1, cfg.text_seq_len)
