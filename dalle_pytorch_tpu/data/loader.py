@@ -1,0 +1,228 @@
+"""Host-side data pipeline.
+
+Capability parity with the reference's data layer:
+* TextImageDataset (/root/reference/dalle_pytorch/loader.py) — pairs
+  image/caption files by stem, random caption choice, RandomResizedCrop,
+  corrupt-file skip-to-neighbour recovery.
+* The WebDataset tar pipeline (/root/reference/train_dalle.py:364-423) — here
+  a dependency-free tar-shard reader (stdlib tarfile) yielding (caption,
+  image) pairs with per-process shard slicing and a warn-and-continue error
+  handler.
+
+TPU-native details: images come out NHWC float32 in [0, 1] as numpy (host)
+arrays; batches are contiguous so the host→device transfer is a single DMA;
+per-process sharding replaces DistributedSampler."""
+from __future__ import annotations
+
+import io
+import random
+import tarfile
+from pathlib import Path
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    from PIL import Image, UnidentifiedImageError
+
+    _PIL_ERRORS: tuple = (UnidentifiedImageError, OSError)
+except ImportError:  # pragma: no cover
+    Image = None
+    _PIL_ERRORS = (OSError,)
+
+IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def random_resized_crop(
+    img: "Image.Image",
+    size: int,
+    rng: random.Random,
+    scale: Tuple[float, float] = (0.75, 1.0),
+    ratio: Tuple[float, float] = (1.0, 1.0),
+) -> "Image.Image":
+    """Square random resized crop (the reference uses torchvision's with
+    ratio=(1,1)); falls back to a center crop when sampling fails."""
+    w, h = img.size
+    area = w * h
+    for _ in range(10):
+        target = area * rng.uniform(*scale)
+        ar = rng.uniform(*ratio)
+        cw = int(round((target * ar) ** 0.5))
+        ch = int(round((target / ar) ** 0.5))
+        if cw <= w and ch <= h:
+            x = rng.randint(0, w - cw)
+            y = rng.randint(0, h - ch)
+            return img.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
+    side = min(w, h)
+    x, y = (w - side) // 2, (h - side) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(x, y, x + side, y + side))
+
+
+def _image_to_array(img: "Image.Image", mode: str) -> np.ndarray:
+    if img.mode != mode:
+        img = img.convert(mode)
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr  # HWC
+
+
+class TextImageDataset:
+    """Folder of images + same-stem .txt caption files."""
+
+    def __init__(
+        self,
+        folder: str,
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = False,
+        resize_ratio: float = 0.75,
+        transparent: bool = False,
+        tokenizer=None,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        path = Path(folder)
+        text_files = {f.stem: f for f in path.glob("**/*.txt")}
+        image_files = {
+            f.stem: f
+            for suffix in IMAGE_SUFFIXES
+            for f in path.glob(f"**/*{suffix}")
+        }
+        keys = sorted(image_files.keys() & text_files.keys())
+        self.keys = keys
+        self.text_files = {k: text_files[k] for k in keys}
+        self.image_files = {k: image_files[k] for k in keys}
+        self.text_len = text_len
+        self.image_size = image_size
+        self.truncate_captions = truncate_captions
+        self.resize_ratio = resize_ratio
+        self.mode = "RGBA" if transparent else "RGB"
+        self.tokenizer = tokenizer
+        self.shuffle = shuffle
+        self._rng = random.Random(seed)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def _skip(self, ind: int):
+        if self.shuffle:
+            return self[self._rng.randint(0, len(self) - 1)]
+        return self[0] if ind >= len(self) - 1 else self[ind + 1]
+
+    def __getitem__(self, ind: int):
+        key = self.keys[ind]
+        descriptions = [d for d in self.text_files[key].read_text().split("\n") if d]
+        if not descriptions:
+            print(f"An exception occurred trying to load file {self.text_files[key]}. Skipping index {ind}")
+            return self._skip(ind)
+        description = self._rng.choice(descriptions)
+        tokens = self.tokenizer.tokenize(
+            description, self.text_len, truncate_text=self.truncate_captions
+        )[0]
+        try:
+            img = Image.open(self.image_files[key])
+            img = random_resized_crop(
+                img.convert(self.mode), self.image_size, self._rng, scale=(self.resize_ratio, 1.0)
+            )
+        except _PIL_ERRORS:
+            print(f"An exception occurred trying to load file {self.image_files[key]}. Skipping index {ind}")
+            return self._skip(ind)
+        return tokens, _image_to_array(img, self.mode)
+
+
+def iterate_batches(
+    dataset: TextImageDataset,
+    batch_size: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+    drop_last: bool = True,
+    epochs: Optional[int] = 1,
+) -> Iterator[dict]:
+    """Batches as {'text': (B, text_len) int64, 'image': (B, H, W, C) f32}.
+    Indices are sharded across processes (DistributedSampler equivalent)."""
+    n = len(dataset)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = np.arange(n)
+        if shuffle:
+            np.random.RandomState(seed + epoch).shuffle(order)
+        order = order[process_index::process_count]
+        for i in range(0, len(order) - (batch_size - 1 if drop_last else 0), batch_size):
+            idx = order[i : i + batch_size]
+            if drop_last and len(idx) < batch_size:
+                break
+            items = [dataset[int(j)] for j in idx]
+            yield {
+                "text": np.stack([t for t, _ in items]),
+                "image": np.stack([im for _, im in items]),
+            }
+        epoch += 1
+
+
+# --- tar-shard (webdataset-style) pipeline ---------------------------------
+
+def _warn_and_continue(exn: Exception, name: str):
+    print(f"[tar pipeline] skipping {name}: {exn!r}")
+
+
+def iterate_tar_shards(
+    shards: Sequence[str],
+    image_size: int,
+    text_len: int,
+    tokenizer,
+    caption_key: str = "txt",
+    image_key: str = "jpg",
+    truncate_captions: bool = True,
+    process_index: int = 0,
+    process_count: int = 1,
+    handler: Callable = _warn_and_continue,
+    seed: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Stream (text_tokens, image_array) pairs from .tar shards, grouping
+    members by basename like WebDataset; shards are split across processes."""
+    rng = random.Random(seed)
+    for shard in list(shards)[process_index::process_count]:
+        try:
+            tf = tarfile.open(shard)
+        except (OSError, tarfile.TarError) as e:
+            handler(e, shard)
+            continue
+        with tf:
+            samples = {}
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                stem, _, ext = member.name.rpartition(".")
+                samples.setdefault(stem, {})[ext.lower()] = member
+            for stem, members in samples.items():
+                img_member = None
+                for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
+                    if ext in members:
+                        img_member = members[ext]
+                        break
+                if img_member is None or caption_key not in members:
+                    continue
+                try:
+                    caption = tf.extractfile(members[caption_key]).read().decode("utf-8").strip()
+                    if not caption:
+                        continue
+                    img = Image.open(io.BytesIO(tf.extractfile(img_member).read()))
+                    img = random_resized_crop(img.convert("RGB"), image_size, rng)
+                    tokens = tokenizer.tokenize(caption, text_len, truncate_text=truncate_captions)[0]
+                    yield tokens, _image_to_array(img, "RGB")
+                except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+                    handler(e, f"{shard}:{stem}")
+
+
+def batch_tar_stream(stream: Iterable, batch_size: int) -> Iterator[dict]:
+    texts: List[np.ndarray] = []
+    images: List[np.ndarray] = []
+    for tokens, img in stream:
+        texts.append(tokens)
+        images.append(img)
+        if len(texts) == batch_size:
+            yield {"text": np.stack(texts), "image": np.stack(images)}
+            texts, images = [], []

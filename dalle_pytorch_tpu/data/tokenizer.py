@@ -1,0 +1,270 @@
+"""Tokenizers.
+
+Four interchangeable tokenizers with the reference's uniform protocol
+(/root/reference/dalle_pytorch/tokenizer.py): `tokenize(texts, context_length,
+truncate_text) -> zero-padded int array`, `encode(text) -> ids`,
+`decode(ids, pad_tokens) -> str`, with pad id 0 doubling as <bos> (DALLE
+remaps pads to unique per-position ids, models/dalle.py).
+
+SimpleTokenizer is a from-scratch pure-Python byte-level BPE over the public
+OpenAI CLIP vocabulary (49,408 entries; merges vendored as a data asset at
+data/vocab/bpe_simple_vocab_16e6.txt).  Arrays are numpy — tokenization is
+host-side work feeding the device pipeline.  An optional C-accelerated encode
+path (native/bpe.cpp via ctypes) is used when the shared library has been
+built; results are identical.
+
+Optional dependencies (ftfy, youtokentome, HF downloads) are gated: missing
+packages degrade gracefully instead of breaking import.
+"""
+from __future__ import annotations
+
+import html
+import os
+from functools import lru_cache
+from pathlib import Path
+from typing import List, Optional, Sequence, Set, Union
+
+import numpy as np
+
+try:
+    import regex as _re
+except ImportError:  # pragma: no cover
+    import re as _re
+
+try:
+    import ftfy as _ftfy
+except ImportError:  # pragma: no cover
+    _ftfy = None
+
+VOCAB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "vocab", "bpe_simple_vocab_16e6.txt")
+
+_WORD_PATTERN = (
+    r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+"""
+)
+
+
+@lru_cache()
+def _byte_to_unicode() -> dict:
+    """Invertible byte -> printable-unicode-char table (the standard GPT-2
+    byte-level BPE alphabet)."""
+    visible = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    mapping = {}
+    fill = 0
+    for b in range(256):
+        if b in visible:
+            mapping[b] = chr(b)
+        else:
+            mapping[b] = chr(256 + fill)
+            fill += 1
+    return mapping
+
+
+def _clean_text(text: str) -> str:
+    if _ftfy is not None:
+        text = _ftfy.fix_text(text)
+    text = html.unescape(html.unescape(text))
+    text = _re.sub(r"\s+", " ", text)
+    return text.strip()
+
+
+def _pad_batch(all_tokens: List[List[int]], texts, context_length: int, truncate_text: bool) -> np.ndarray:
+    result = np.zeros((len(all_tokens), context_length), dtype=np.int64)
+    for i, tokens in enumerate(all_tokens):
+        if len(tokens) > context_length:
+            if truncate_text:
+                tokens = tokens[:context_length]
+            else:
+                raise RuntimeError(
+                    f"Input {texts[i]} is too long for context length {context_length}"
+                )
+        result[i, : len(tokens)] = np.asarray(tokens, dtype=np.int64)
+    return result
+
+
+class SimpleTokenizer:
+    """Byte-level BPE over the public CLIP vocabulary (vocab_size 49408)."""
+
+    def __init__(self, bpe_path: str = VOCAB_PATH, use_native: bool = True):
+        self.byte_encoder = _byte_to_unicode()
+        self.byte_decoder = {c: b for b, c in self.byte_encoder.items()}
+
+        lines = Path(bpe_path).read_text(encoding="utf8").split("\n")
+        # header line first; the file carries more merges than CLIP uses
+        merge_lines = lines[1 : 49152 - 256 - 2 + 1]
+        merges = [tuple(line.split()) for line in merge_lines]
+
+        base = list(self.byte_encoder.values())
+        symbols = base + [c + "</w>" for c in base]
+        symbols += ["".join(pair) for pair in merges]
+        symbols += ["<|startoftext|>", "<|endoftext|>"]
+
+        self.encoder = {sym: i for i, sym in enumerate(symbols)}
+        self.decoder = {i: sym for sym, i in self.encoder.items()}
+        self.merge_rank = {pair: i for i, pair in enumerate(merges)}
+        self.vocab_size = len(symbols)
+        assert self.vocab_size == 49408
+
+        self._pattern = _re.compile(_WORD_PATTERN, _re.IGNORECASE)
+        self._cache = {}
+        self._native = None
+        if use_native:
+            self._native = _try_load_native(bpe_path)
+
+    # -- BPE ----------------------------------------------------------------
+    def _merge_word(self, token: str) -> List[str]:
+        """Apply merges to one pre-token (already byte-mapped), returning the
+        final symbol sequence (last symbol carries </w>)."""
+        if token in self._cache:
+            return self._cache[token]
+        parts: List[str] = list(token[:-1]) + [token[-1] + "</w>"]
+        while len(parts) > 1:
+            ranked = [
+                (self.merge_rank.get((parts[i], parts[i + 1]), None), i)
+                for i in range(len(parts) - 1)
+            ]
+            candidates = [(r, i) for r, i in ranked if r is not None]
+            if not candidates:
+                break
+            best_rank = min(candidates)[0]
+            first, second = None, None
+            merged: List[str] = []
+            i = 0
+            while i < len(parts):
+                if (
+                    i < len(parts) - 1
+                    and self.merge_rank.get((parts[i], parts[i + 1])) == best_rank
+                ):
+                    merged.append(parts[i] + parts[i + 1])
+                    i += 2
+                else:
+                    merged.append(parts[i])
+                    i += 1
+            parts = merged
+        self._cache[token] = parts
+        return parts
+
+    def encode(self, text: str) -> List[int]:
+        text = _clean_text(text).lower()
+        if self._native is not None:
+            return self._native.encode(text)
+        ids: List[int] = []
+        for word in self._pattern.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in word.encode("utf-8"))
+            ids.extend(self.encoder[sym] for sym in self._merge_word(mapped))
+        return ids
+
+    def decode(self, tokens, remove_start_end: bool = True, pad_tokens: Set[int] = frozenset()):
+        tokens = _to_list(tokens)
+        if remove_start_end:
+            specials = {self.encoder["<|startoftext|>"], self.encoder["<|endoftext|>"], 0}
+            tokens = [t for t in tokens if t not in specials]
+        text = "".join(self.decoder[t] for t in tokens if t not in pad_tokens)
+        raw = bytearray(self.byte_decoder[c] for c in text)
+        return raw.decode("utf-8", errors="replace").replace("</w>", " ")
+
+    def tokenize(self, texts, context_length: int = 256, truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        return _pad_batch([self.encode(t) for t in texts], texts, context_length, truncate_text)
+
+
+def _to_list(tokens) -> List[int]:
+    if hasattr(tokens, "tolist"):
+        return [int(t) for t in tokens.tolist()]
+    return [int(t) for t in tokens]
+
+
+def _try_load_native(bpe_path: str):
+    """Load the C++ BPE encoder (native/bpe.cpp) if its shared library was
+    built; fall back to pure Python otherwise."""
+    try:
+        from dalle_pytorch_tpu.data._native_bpe import NativeBPE
+
+        return NativeBPE(bpe_path)
+    except Exception:
+        return None
+
+
+# -- huggingface tokenizer ---------------------------------------------------
+
+class HugTokenizer:
+    def __init__(self, bpe_path: Optional[str] = None):
+        from tokenizers import Tokenizer
+        from tokenizers.processors import ByteLevel
+
+        path = Path(bpe_path)
+        assert path.exists(), f"BPE json path {str(path)} does not exist"
+        tok = Tokenizer.from_file(str(path))
+        tok.post_processor = ByteLevel(trim_offsets=True)
+        self.tokenizer = tok
+        self.vocab_size = tok.get_vocab_size()
+
+    def decode(self, tokens, pad_tokens: Set[int] = frozenset()):
+        tokens = [t for t in _to_list(tokens) if t not in set(pad_tokens) | {0}]
+        return self.tokenizer.decode(tokens, skip_special_tokens=True)
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text).ids
+
+    def tokenize(self, texts, context_length: int = 256, truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        return _pad_batch([self.encode(t) for t in texts], texts, context_length, truncate_text)
+
+
+# -- chinese tokenizer -------------------------------------------------------
+
+class ChineseTokenizer:
+    def __init__(self, model_name: str = "bert-base-chinese"):
+        from transformers import BertTokenizer
+
+        self.tokenizer = BertTokenizer.from_pretrained(model_name)
+        self.vocab_size = self.tokenizer.vocab_size
+
+    def decode(self, tokens, pad_tokens: Set[int] = frozenset()):
+        tokens = [t for t in _to_list(tokens) if t not in set(pad_tokens) | {0}]
+        return self.tokenizer.decode(tokens)
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text, add_special_tokens=False)
+
+    def tokenize(self, texts, context_length: int = 256, truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        return _pad_batch([self.encode(t) for t in texts], texts, context_length, truncate_text)
+
+
+# -- youtokentome ------------------------------------------------------------
+
+class YttmTokenizer:
+    def __init__(self, bpe_path: Optional[str] = None):
+        import youtokentome as yttm
+
+        path = Path(bpe_path)
+        assert path.exists(), f"BPE model path {str(path)} does not exist"
+        self.tokenizer = yttm.BPE(model=str(path))
+        self.vocab_size = self.tokenizer.vocab_size()
+        self._yttm = yttm
+
+    def decode(self, tokens, pad_tokens: Set[int] = frozenset()):
+        return self.tokenizer.decode(_to_list(tokens), ignore_ids=set(pad_tokens) | {0})
+
+    def encode(self, texts: Union[str, Sequence[str]]):
+        single = isinstance(texts, str)
+        out = self.tokenizer.encode(
+            [texts] if single else list(texts), output_type=self._yttm.OutputType.ID
+        )
+        return out[0] if single else out
+
+    def tokenize(self, texts, context_length: int = 256, truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        return _pad_batch(self.encode(texts), texts, context_length, truncate_text)
+
+
+# module-level default, like the reference's singleton
+tokenizer = SimpleTokenizer()

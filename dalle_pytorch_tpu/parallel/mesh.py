@@ -1,0 +1,65 @@
+"""Device-mesh construction.
+
+The reference scales out through NCCL process groups managed by DeepSpeed /
+Horovod launchers (SURVEY.md §2 rows 15-19).  The TPU-native replacement is a
+single logical `jax.sharding.Mesh` over all devices with four named axes:
+
+  dp    pure data parallelism (gradients all-reduced by XLA over ICI)
+  fsdp  data parallelism + parameter/optimizer sharding (ZeRO-3 style)
+  tp    tensor parallelism (attention heads / ff hidden sharded)
+  sp    sequence/context parallelism (ring attention, parallel/ring.py)
+
+Collectives are never called explicitly for training — XLA emits them from
+sharding annotations, riding ICI within a slice and DCN across slices.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP)
+
+# batch is sharded over every data-like axis
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = -1  # -1: absorb all remaining devices
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.fsdp * self.tp * self.sp
+        dp = self.dp
+        if dp == -1:
+            assert n_devices % fixed == 0, (n_devices, fixed)
+            dp = n_devices // fixed
+        assert dp * fixed == n_devices, (
+            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
+        )
+        return MeshConfig(dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(cfg: MeshConfig = MeshConfig(), devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = cfg.resolve(len(devices))
+    arr = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp)
+    return Mesh(arr, MESH_AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,85 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+The reference has no sequence parallelism (SURVEY.md §2.3) — it attacks long
+sequences with sparse patterns instead.  For a first-class long-context story
+on TPU we shard the sequence over devices and rotate K/V blocks around the
+ring with ppermute while accumulating attention with an online (flash-style)
+softmax: memory per device is O(n/P), communication overlaps with the block
+matmuls, and the collectives ride ICI neighbour links.
+
+The math is the standard blockwise-softmax recurrence (m, l, acc carried per
+query), computed in f32 regardless of input dtype."""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from dalle_pytorch_tpu.parallel.mesh import AXIS_SP
+
+P = PartitionSpec
+_NEG = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    """q, k, v: (b, h, n_loc, d) — the local sequence shard.  Runs the full
+    ring inside shard_map."""
+    n_dev = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, n, d = q.shape
+
+    q32 = q.astype(jnp.float32) * scale
+    m = jnp.full((b, h, n, 1), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, n, 1), jnp.float32)
+    acc = jnp.zeros((b, h, n, d), jnp.float32)
+
+    i_loc = jnp.arange(n)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    k_cur, v_cur = k, v
+    for step in range(n_dev):
+        src = jnp.mod(my - step, n_dev)  # device whose block we currently hold
+        s = jnp.einsum("bhid,bhjd->bhij", q32, k_cur.astype(jnp.float32))
+        if causal:
+            q_pos = my * n + i_loc[:, None]
+            k_pos = src * n + i_loc[None, :]
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p_exp = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p_exp, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhij,bhjd->bhid", p_exp, v_cur.astype(jnp.float32))
+        m = m_new
+        if step < n_dev - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = AXIS_SP,
+    scale: float | None = None,
+):
+    """Global (b, h, n, d) attention with n sharded over `axis_name`.
+
+    Equivalent to dense softmax attention (ops/attention.py) with a causal
+    mask; n must divide evenly by the axis size."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

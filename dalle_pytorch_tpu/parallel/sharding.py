@@ -1,0 +1,132 @@
+"""Parameter / optimizer-state partitioning rules.
+
+ZeRO parity map (SURVEY.md §2.3):
+  zero_stage 0  — params + optimizer state replicated (plain DP)
+  zero_stage 1/2 — params replicated, optimizer state sharded over `fsdp`
+                   (the grad/optimizer sharding halves of DeepSpeed ZeRO; in
+                   XLA's execution model grads are transient so 1 and 2
+                   coincide)
+  zero_stage 3  — params AND optimizer state sharded over `fsdp`
+                   (FSDP-style; XLA all-gathers weights around each use)
+
+Tensor parallelism shards attention heads and ff hidden over `tp` — qkv /
+ff-in projections column-wise, out / ff-out projections row-wise, so XLA emits
+exactly one all-reduce per residual branch (the Megatron pattern, expressed
+through GSPMD annotations instead of hand-written collectives)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dalle_pytorch_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP
+
+P = PartitionSpec
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _shard_largest(leaf, axis_name: str, mesh: Mesh, min_size: int = 2 ** 14) -> PartitionSpec:
+    """Spec sharding the largest divisible dim of `leaf` over `axis_name`."""
+    if leaf.ndim == 0 or leaf.size < min_size:
+        return P()
+    axis_size = mesh.shape[axis_name]
+    dims = list(leaf.shape)
+    order = sorted(range(len(dims)), key=lambda i: -dims[i])
+    for i in order:
+        if dims[i] % axis_size == 0 and dims[i] >= axis_size:
+            spec = [None] * len(dims)
+            spec[i] = axis_name
+            return P(*spec)
+    return P()
+
+
+def _tp_spec(path: str, leaf, fsdp: Optional[str]) -> Optional[PartitionSpec]:
+    """Megatron-style TP placement by parameter path; None = no TP rule."""
+    if leaf.ndim == 2:
+        if "qkv/w" in path or "w1/w" in path:
+            return P(fsdp, AXIS_TP)  # column parallel
+        if ("shared_attn" in path and "out/w" in path) or "w2/w" in path:
+            return P(AXIS_TP, fsdp)  # row parallel
+        if "logits_linear/w" in path:
+            return P(fsdp, AXIS_TP)  # vocab-sharded output projection
+    if leaf.ndim == 1:
+        if "w1/b" in path or "logits_linear/b" in path:
+            return P(AXIS_TP)
+    return None
+
+
+def _rule(path: str, leaf, mesh: Mesh, zero_stage: int, tensor_parallel: bool, params_sharded: bool):
+    fsdp = AXIS_FSDP if params_sharded else None
+    if tensor_parallel:
+        tp = _tp_spec(path, leaf, fsdp)
+        if tp is not None:
+            return tp
+    if params_sharded:
+        return _shard_largest(leaf, AXIS_FSDP, mesh)
+    return P()
+
+
+def param_specs(params: Any, mesh: Mesh, zero_stage: int = 0, tensor_parallel: Optional[bool] = None):
+    """A pytree of PartitionSpec congruent with `params`."""
+    if tensor_parallel is None:
+        tensor_parallel = mesh.shape[AXIS_TP] > 1
+    params_sharded = zero_stage >= 3 and mesh.shape[AXIS_FSDP] > 1
+
+    def rule(path, leaf):
+        return _rule(_path_str(path), leaf, mesh, zero_stage, tensor_parallel, params_sharded)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def opt_state_specs(opt_state: Any, mesh: Mesh, zero_stage: int = 0, tensor_parallel: Optional[bool] = None):
+    """Specs for the optimizer state.  Moment tensors mirror the param tree
+    inside the optax state, so the same path-suffix rules apply; with ZeRO-1/2
+    the moments are additionally sharded over `fsdp` even though params are
+    replicated."""
+    if tensor_parallel is None:
+        tensor_parallel = mesh.shape[AXIS_TP] > 1
+    params_sharded = zero_stage >= 3 and mesh.shape[AXIS_FSDP] > 1
+    moments_sharded = zero_stage >= 1 and mesh.shape[AXIS_FSDP] > 1
+
+    def rule(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return P()
+        p = _path_str(path)
+        spec = _rule(p, leaf, mesh, zero_stage, tensor_parallel, params_sharded)
+        if spec == P() and moments_sharded:
+            return _shard_largest(leaf, AXIS_FSDP, mesh)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(rule, opt_state)
+
+
+def tree_shardings(specs: Any, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def shard_tree(tree: Any, specs: Any, mesh: Mesh):
+    """device_put every leaf with its NamedSharding (host → sharded device)."""
+    return jax.tree_util.tree_map(
+        lambda spec, leaf: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        specs,
+        tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )

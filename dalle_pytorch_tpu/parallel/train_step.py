@@ -1,0 +1,132 @@
+"""Sharded, jitted training steps.
+
+Replaces the reference's backend-dispatched backward/step
+(/root/reference/train_dalle.py:609-619 + the DeepSpeed/Horovod engines): one
+jit-compiled function containing forward, backward, gradient accumulation
+(lax.scan microbatching — SURVEY.md §2.3), optimizer update, and the loss
+all-reduce.  Gradient reduction across data axes is emitted by XLA from the
+sharding annotations; nothing here calls a collective explicitly.
+
+Mixed precision is the TPU-native bf16 policy: master params and optimizer
+state in f32, forward/backward compute in bf16, gradient accumulation in f32
+(no loss scaling needed on TPU — replacing Apex AMP / fp16 engines)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dalle_pytorch_tpu.core.pytree import cast_floating
+from dalle_pytorch_tpu.parallel.mesh import BATCH_AXES
+from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs, tree_shardings
+
+P = PartitionSpec
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.step, s.params, s.opt_state), None),
+    lambda _, c: TrainState(*c),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSettings:
+    grad_accum: int = 1
+    compute_dtype: Any = jnp.float32
+    clip_grad_norm: Optional[float] = None
+    zero_stage: int = 0
+
+
+def make_train_step(
+    loss_fn: Callable,  # (params, batch, key) -> scalar loss
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    settings: StepSettings = StepSettings(),
+    pspecs: Any = None,
+):
+    """Build (init_fn, step_fn).
+
+    init_fn(params) -> TrainState (sharded when a mesh is given).
+    step_fn(state, batch, key) -> (state, metrics); batch leaves have leading
+    dim grad_accum * microbatch and are sharded over the data axes."""
+
+    def init_fn(params):
+        opt_state = optimizer.init(params)
+        state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
+        if mesh is None:
+            return state
+        ps = pspecs if pspecs is not None else param_specs(params, mesh, settings.zero_stage)
+        os_specs = opt_state_specs(opt_state, mesh, settings.zero_stage)
+        state_specs = TrainState(P(), ps, os_specs)
+        return jax.tree_util.tree_map(
+            lambda spec, leaf: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            state_specs,
+            state,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+    def grads_and_loss(params, batch, key):
+        accum = settings.grad_accum
+        compute_params = cast_floating(params, settings.compute_dtype)
+
+        if accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(compute_params, batch, key)
+            return cast_floating(grads, jnp.float32), loss
+
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
+        )
+        keys = jax.random.split(key, accum)
+
+        def body(carry, mb_and_key):
+            g_acc, l_acc = carry
+            mb, k = mb_and_key
+            loss, grads = jax.value_and_grad(loss_fn)(compute_params, mb, k)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+            )
+            return (g_acc, l_acc + loss), None
+
+        zero = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (g, l), _ = jax.lax.scan(body, (zero, 0.0), (micro, keys))
+        scale = 1.0 / accum
+        return jax.tree_util.tree_map(lambda x: x * scale, g), l * scale
+
+    def step_fn_inner(state: TrainState, batch, key):
+        grads, loss = grads_and_loss(state.params, batch, key)
+        if settings.clip_grad_norm is not None:
+            gnorm = optax.global_norm(grads)
+            factor = jnp.minimum(1.0, settings.clip_grad_norm / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, params, opt_state)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        return new_state, metrics
+
+    if mesh is None:
+        return init_fn, jax.jit(step_fn_inner, donate_argnums=0)
+
+    batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+
+    def step_fn(state, batch, key):
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, batch_sh), batch
+        )
+        return step_fn_inner(state, batch, key)
+
+    return init_fn, jax.jit(step_fn, donate_argnums=0)

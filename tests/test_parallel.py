@@ -1,0 +1,220 @@
+"""Multi-device tests on the 8-device virtual CPU mesh (conftest.py)."""
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.ops.attention import attend
+from dalle_pytorch_tpu.ops.masks import causal_mask
+from dalle_pytorch_tpu.parallel import backend as backend_mod
+from dalle_pytorch_tpu.parallel.mesh import MeshConfig, make_mesh
+from dalle_pytorch_tpu.parallel.ring import ring_attention
+from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs
+from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState, make_train_step
+
+P = PartitionSpec
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32, depth=2, num_text_tokens=64, text_seq_len=8, heads=4, dim_head=8,
+        num_image_tokens=32, image_fmap_size=4,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def batch_for(cfg, b=8, seed=0):
+    kt, ki = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "text": jax.random.randint(kt, (b, cfg.text_seq_len), 0, cfg.num_text_tokens),
+        "image_codes": jax.random.randint(ki, (b, cfg.image_seq_len), 0, cfg.num_image_tokens),
+    }
+
+
+def dalle_loss(cfg):
+    def loss_fn(params, batch, key):
+        return dalle_mod.forward(
+            params, cfg, batch["text"], batch["image_codes"], return_loss=True
+        )
+
+    return loss_fn
+
+
+def test_mesh_construction():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
+    assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+    mesh = make_mesh(MeshConfig())  # all 8 into dp
+    assert mesh.shape["dp"] == 8
+
+
+def test_ring_attention_matches_dense():
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=8))
+    b, h, n, d = 2, 4, 64, 16
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (b, h, n, d), jnp.float32) for i in range(3)
+    )
+    got = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    want = np.asarray(attend(q * d ** -0.5, k, v, mask=causal_mask(n)))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_ring_attention_non_causal():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, tp=1, sp=4))
+    b, h, n, d = 1, 2, 32, 8
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (b, h, n, d), jnp.float32) for i in range(3)
+    )
+    got = np.asarray(ring_attention(q, k, v, mesh, causal=False))
+    want = np.asarray(attend(q * d ** -0.5, k, v, mask=None))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+@pytest.mark.parametrize("zero_stage", [0, 1, 3])
+def test_sharded_training_matches_single_device(zero_stage):
+    """The same params + batch must produce the same loss trajectory on an
+    8-way mesh (any ZeRO stage) as on a single device."""
+    cfg = tiny_cfg()
+    batch = batch_for(cfg)
+    opt = optax.adam(1e-3)
+    loss_fn = dalle_loss(cfg)
+
+    # single-device reference (fresh buffers — step_fn donates its input state)
+    init_s, step_s = make_train_step(loss_fn, opt, mesh=None)
+    state_s = init_s(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    losses_s = []
+    for i in range(3):
+        state_s, m = step_s(state_s, batch, jax.random.PRNGKey(i))
+        losses_s.append(float(m["loss"]))
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    init_m, step_m = make_train_step(
+        loss_fn, opt, mesh=mesh, settings=StepSettings(zero_stage=zero_stage)
+    )
+    state_m = init_m(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    losses_m = []
+    for i in range(3):
+        state_m, m = step_m(state_m, batch, jax.random.PRNGKey(i))
+        losses_m.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses_s, losses_m, rtol=2e-4)
+
+
+def test_zero3_params_actually_sharded():
+    cfg = tiny_cfg(dim=64)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=8))
+    specs = param_specs(params, mesh, zero_stage=3)
+    leaves = jax.tree_util.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert any(s != P() for s in leaves), "no parameter got sharded under ZeRO-3"
+
+    init_fn, _ = make_train_step(dalle_loss(cfg), optax.adam(1e-3), mesh=mesh,
+                                 settings=StepSettings(zero_stage=3))
+    state = init_fn(params)
+    emb = state.params["text_emb"]["table"]
+    assert len(emb.sharding.device_set) == 8
+
+
+def test_zero1_opt_state_sharded_params_replicated():
+    cfg = tiny_cfg(dim=64)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=8))
+    init_fn, _ = make_train_step(dalle_loss(cfg), optax.adam(1e-3), mesh=mesh,
+                                 settings=StepSettings(zero_stage=1))
+    state = init_fn(params)
+    # params replicated
+    assert state.params["text_emb"]["table"].sharding.is_fully_replicated
+    # some moment is sharded
+    shardings = [l.sharding for l in jax.tree_util.tree_leaves(state.opt_state) if hasattr(l, "sharding") and l.ndim > 0]
+    assert any(not s.is_fully_replicated for s in shardings)
+
+
+def test_tensor_parallel_step():
+    cfg = tiny_cfg()
+    batch = batch_for(cfg, b=4)
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, tp=4))
+    init_fn, step_fn = make_train_step(dalle_loss(cfg), optax.adam(1e-3), mesh=mesh)
+    state = init_fn(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    qkv = state.params["transformer"]["shared_attn"]["0"]["qkv"]["w"]
+    assert not qkv.sharding.is_fully_replicated
+
+    init_s, step_s = make_train_step(dalle_loss(cfg), optax.adam(1e-3), mesh=None)
+    state_s = init_s(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    _, m_s = step_s(state_s, batch, jax.random.PRNGKey(0))
+    _, m_m = step_fn(state, batch, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(m_s["loss"]), float(m_m["loss"]), rtol=2e-4)
+
+
+def test_grad_accumulation_equivalence():
+    """accum=4 over batch 8 must equal accum=1 over the same batch (mean loss
+    and resulting params)."""
+    cfg = tiny_cfg()
+    batch = batch_for(cfg, b=8)
+    opt = optax.sgd(1e-2)
+    loss_fn = dalle_loss(cfg)
+
+    init1, step1 = make_train_step(loss_fn, opt, settings=StepSettings(grad_accum=1))
+    init4, step4 = make_train_step(loss_fn, opt, settings=StepSettings(grad_accum=4))
+    s1, _ = step1(init1(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)), batch, jax.random.PRNGKey(0))
+    s4, _ = step4(init4(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)), batch, jax.random.PRNGKey(0))
+    for a, b_ in zip(jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-6)
+
+
+def test_bf16_compute_policy():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    batch = batch_for(cfg)
+    init_fn, step_fn = make_train_step(
+        dalle_loss(cfg), optax.adam(1e-3),
+        settings=StepSettings(compute_dtype=jnp.bfloat16),
+    )
+    state, m = step_fn(init_fn(params), batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))
+    # master params stay f32
+    assert state.params["logits_linear"]["w"].dtype == jnp.float32
+
+
+def test_grad_clipping():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    batch = batch_for(cfg)
+    init_fn, step_fn = make_train_step(
+        dalle_loss(cfg), optax.sgd(1e-3), settings=StepSettings(clip_grad_norm=0.1)
+    )
+    _, m = step_fn(init_fn(params), batch, jax.random.PRNGKey(0))
+    assert float(m["grad_norm"]) <= 0.1 + 1e-5
+
+
+def test_backend_registry_and_dummy():
+    parser = argparse.ArgumentParser()
+    parser = backend_mod.wrap_arg_parser(parser)
+    args = parser.parse_args(["--distributed_backend", "none"])
+    be = backend_mod.set_backend_from_args(args)
+    be.initialize()
+    assert be.get_world_size() == 1 and be.is_root_worker()
+    assert not backend_mod.is_distributed
+    be.check_batch_size(4)
+    assert be.average_all(3.0) == 3.0
+
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    state, step_fn, data, sched = be.distribute(
+        loss_fn=dalle_loss(cfg), params=params, optimizer=optax.adam(1e-3),
+        training_data="data", lr_scheduler="sched",
+    )
+    assert isinstance(state, TrainState) and data == "data" and sched == "sched"
+    _, m = step_fn(state, batch_for(cfg), jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_backend_unknown_raises():
+    ns = argparse.Namespace(distributed_backend="nccl")
+    with pytest.raises(ValueError, match="unknown distributed backend"):
+        backend_mod.set_backend_from_args(ns)
