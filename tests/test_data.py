@@ -1,0 +1,148 @@
+import io
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dalle_pytorch_tpu.data.loader import (
+    TextImageDataset,
+    batch_tar_stream,
+    iterate_batches,
+    iterate_tar_shards,
+)
+from dalle_pytorch_tpu.data.tokenizer import SimpleTokenizer
+
+TOK = SimpleTokenizer(use_native=False)
+
+
+# --- SimpleTokenizer --------------------------------------------------------
+
+def test_vocab_size():
+    assert TOK.vocab_size == 49408
+    assert TOK.encoder["<|startoftext|>"] == 49406
+    assert TOK.encoder["<|endoftext|>"] == 49407
+
+
+def test_roundtrip():
+    # BPE decode re-spaces at word boundaries (reference behavior), so compare
+    # space-normalized text; pure lowercase word sequences roundtrip exactly.
+    for text in [
+        "a small orange circle",
+        "the quick brown fox jumps over the lazy dog",
+    ]:
+        assert TOK.decode(TOK.encode(text)).strip() == text, text
+    for text in ["Hello, World! 123", "naïve café — résumé"]:
+        back = TOK.decode(TOK.encode(text))
+        assert back.replace(" ", "") == text.lower().replace(" ", ""), (text, back)
+
+
+def test_known_encodings_stable():
+    """Golden values: single-letter and common-word tokens land in the
+    documented vocab regions (bytes, byte+</w>, merges)."""
+    ids = TOK.encode("a")
+    assert ids == [TOK.encoder["a</w>"]]
+    assert 256 <= ids[0] < 512  # byte+</w> region
+    ids = TOK.encode("the")
+    assert ids == [TOK.encoder["the</w>"]]
+
+
+def test_tokenize_padding_and_truncate():
+    out = TOK.tokenize(["a cat", "a dog"], context_length=16)
+    assert out.shape == (2, 16) and out.dtype == np.int64
+    assert (out[:, -1] == 0).all()
+
+    long_text = " ".join(["word"] * 50)
+    with pytest.raises(RuntimeError, match="too long"):
+        TOK.tokenize(long_text, context_length=8)
+    t = TOK.tokenize(long_text, context_length=8, truncate_text=True)
+    assert t.shape == (1, 8) and (t != 0).all()
+
+
+def test_decode_skips_pads_and_specials():
+    ids = TOK.encode("blue square")
+    padded = list(ids) + [0, 0, 49406, 49407]
+    assert TOK.decode(padded).strip() == "blue square"
+    # per-position custom pad tokens (the DALLE unique-pad protocol)
+    assert TOK.decode(list(ids) + [40000], pad_tokens={40000}).strip() == "blue square"
+
+
+# --- folder dataset ---------------------------------------------------------
+
+@pytest.fixture()
+def data_folder(tmp_path):
+    for i, (name, caption) in enumerate(
+        [("aa", "a red circle"), ("bb", "a green square\na verdant box"), ("cc", "a blue dot")]
+    ):
+        arr = (np.random.RandomState(i).rand(20, 24, 3) * 255).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"{name}.png")
+        (tmp_path / f"{name}.txt").write_text(caption)
+    # an image with no caption pair (ignored) and a corrupt image with caption
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(tmp_path / "orphan.png")
+    (tmp_path / "corrupt.txt").write_text("broken")
+    (tmp_path / "corrupt.png").write_bytes(b"not an image")
+    return tmp_path
+
+
+def test_text_image_dataset(data_folder):
+    ds = TextImageDataset(str(data_folder), text_len=16, image_size=16, tokenizer=TOK)
+    assert len(ds) == 4  # aa, bb, cc, corrupt (pairs only)
+    tokens, img = ds[0]
+    assert tokens.shape == (16,)
+    assert img.shape == (16, 16, 3)
+    assert img.dtype == np.float32 and 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_corrupt_image_skips_to_neighbour(data_folder):
+    ds = TextImageDataset(str(data_folder), text_len=16, image_size=16, tokenizer=TOK)
+    idx = ds.keys.index("corrupt")
+    tokens, img = ds[idx]  # must not raise
+    assert img.shape == (16, 16, 3)
+
+
+def test_iterate_batches_sharding(data_folder):
+    ds = TextImageDataset(str(data_folder), text_len=16, image_size=16, tokenizer=TOK)
+    all_b = list(iterate_batches(ds, batch_size=2, shuffle=False, drop_last=True))
+    assert all_b and all_b[0]["text"].shape == (2, 16)
+    assert all_b[0]["image"].shape == (2, 16, 16, 3)
+    # two processes see disjoint halves
+    b0 = list(iterate_batches(ds, 1, shuffle=False, process_index=0, process_count=2))
+    b1 = list(iterate_batches(ds, 1, shuffle=False, process_index=1, process_count=2))
+    assert len(b0) + len(b1) == len(ds)
+
+
+# --- tar-shard pipeline -----------------------------------------------------
+
+@pytest.fixture()
+def tar_shard(tmp_path):
+    path = tmp_path / "shard-000.tar"
+    with tarfile.open(path, "w") as tf:
+        for i, caption in enumerate(["a red bird", "a tall tree", ""]):
+            img = Image.fromarray((np.random.RandomState(i).rand(20, 20, 3) * 255).astype(np.uint8))
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"sample{i:03d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            cap = caption.encode()
+            info = tarfile.TarInfo(f"sample{i:03d}.txt")
+            info.size = len(cap)
+            tf.addfile(info, io.BytesIO(cap))
+    return path
+
+
+def test_tar_pipeline(tar_shard):
+    stream = iterate_tar_shards([str(tar_shard)], image_size=16, text_len=16, tokenizer=TOK)
+    batches = list(batch_tar_stream(stream, batch_size=2))
+    assert len(batches) == 1  # empty-caption sample filtered out
+    assert batches[0]["text"].shape == (2, 16)
+    assert batches[0]["image"].shape == (2, 16, 16, 3)
+
+
+def test_tar_pipeline_missing_shard_warns(tar_shard, capsys):
+    stream = iterate_tar_shards(
+        ["/nonexistent.tar", str(tar_shard)], image_size=16, text_len=16, tokenizer=TOK
+    )
+    assert len(list(stream)) == 2
+    assert "skipping" in capsys.readouterr().out
