@@ -2,7 +2,37 @@
 with the capability set of lucidrains/DALLE-pytorch, designed from scratch for
 TPU hardware: functional models over parameter pytrees, static-shape jitted
 train/sample steps, attention sparsity as static masks + Pallas kernels, and
-distribution via mesh sharding instead of NCCL all-reduce."""
+distribution via mesh sharding instead of NCCL all-reduce.
+
+Public surface (mirroring the reference's `from dalle_pytorch import ...`):
+configs + init/apply functions for DALLE, CLIP and DiscreteVAE, the sampling
+entry points, and the parallel/data/training subsystems as submodules."""
+from dalle_pytorch_tpu.models.clip import CLIPConfig, forward as clip_forward, init_clip
+from dalle_pytorch_tpu.models.dalle import DALLEConfig, forward as dalle_forward, init_dalle
+from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts, sample_image_codes
+from dalle_pytorch_tpu.models.vae import (
+    DiscreteVAEConfig,
+    decode_indices,
+    forward as vae_forward,
+    get_codebook_indices,
+    init_discrete_vae,
+)
 from dalle_pytorch_tpu.version import __version__
 
-__all__ = ["__version__"]
+__all__ = [
+    "CLIPConfig",
+    "DALLEConfig",
+    "DiscreteVAEConfig",
+    "__version__",
+    "clip_forward",
+    "dalle_forward",
+    "decode_indices",
+    "generate_images",
+    "generate_texts",
+    "get_codebook_indices",
+    "init_clip",
+    "init_dalle",
+    "init_discrete_vae",
+    "sample_image_codes",
+    "vae_forward",
+]

@@ -1,0 +1,126 @@
+"""Image generation CLI — parity with /root/reference/generate.py: loads a
+trained checkpoint ({hparams, vae_params, weights, vae_class_name, version}),
+validates it, splits prompts on '|', optionally completes prompts first
+(--gentxt), samples in batch_size chunks, and saves PNGs per prompt
+directory."""
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts
+from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+from dalle_pytorch_tpu.version import __version__
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description="Generate images from a trained DALL-E")
+    parser.add_argument("--dalle_path", type=str, required=True)
+    parser.add_argument("--text", type=str, required=True, help="prompt(s), | separated")
+    parser.add_argument("--num_images", type=int, default=128)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--top_k", type=float, default=0.9, help="filter threshold (0.5-1.0)")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--cond_scale", type=float, default=1.0, help="classifier-free guidance scale")
+    parser.add_argument("--outputs_dir", type=str, default="./outputs")
+    parser.add_argument("--gentxt", action="store_true", help="complete the prompt with DALL-E first")
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def get_tokenizer(args):
+    if args.chinese:
+        return tokenizer_mod.ChineseTokenizer()
+    if args.hug:
+        return tokenizer_mod.HugTokenizer(args.bpe_path)
+    if args.bpe_path is not None:
+        suffix = Path(args.bpe_path).suffix
+        return (
+            tokenizer_mod.HugTokenizer(args.bpe_path)
+            if suffix == ".json"
+            else tokenizer_mod.YttmTokenizer(args.bpe_path)
+        )
+    return tokenizer_mod.tokenizer
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    path = Path(args.dalle_path)
+    assert path.exists(), f"trained DALL-E {path} does not exist"
+
+    trees, meta = load_checkpoint(str(path))
+    assert meta.get("vae_class_name", "DiscreteVAE") == "DiscreteVAE", (
+        f"unsupported VAE class {meta.get('vae_class_name')} in checkpoint"
+    )
+    if meta.get("version") != __version__:
+        print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
+
+    hparams = dict(meta["hparams"])
+    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
+        if hparams.get(k) is not None:
+            hparams[k] = tuple(hparams[k])
+    dalle_cfg = DALLEConfig(**hparams)
+    vae_cfg = DiscreteVAEConfig(**meta["vae_params"])
+    params = trees["weights"]
+    vae_params = trees["vae_weights"]
+
+    tokenizer = get_tokenizer(args)
+    key = jax.random.PRNGKey(args.seed)
+    outputs_dir = Path(args.outputs_dir)
+
+    paths = []
+    for raw_text in args.text.split("|"):
+        raw_text = raw_text.strip()
+        if args.gentxt:
+            prompt_ids = jnp.asarray(tokenizer.tokenize(raw_text, dalle_cfg.text_seq_len, truncate_text=True))
+            n0 = int((np.asarray(prompt_ids)[0] != 0).sum())
+            key, gk = jax.random.split(key)
+            completed = generate_texts(params, dalle_cfg, gk, text=prompt_ids[:, :max(n0, 1)])
+            pad_tokens = set(
+                range(dalle_cfg.num_text_tokens_padded - dalle_cfg.text_seq_len,
+                      dalle_cfg.num_text_tokens_padded)
+            )
+            raw_text = tokenizer.decode(np.asarray(completed[0]), pad_tokens=pad_tokens)
+            print(f"completed text: {raw_text}")
+
+        text_tokens = tokenizer.tokenize(raw_text, dalle_cfg.text_seq_len, truncate_text=True)
+        text_tokens = np.repeat(text_tokens, args.num_images, axis=0)
+
+        out_dir = outputs_dir / raw_text.replace(" ", "_")[:100]
+        out_dir.mkdir(parents=True, exist_ok=True)
+
+        produced = 0
+        for i in range(0, args.num_images, args.batch_size):
+            chunk = jnp.asarray(text_tokens[i : i + args.batch_size])
+            key, sk = jax.random.split(key)
+            images = generate_images(
+                params, dalle_cfg, vae_params, vae_cfg, chunk, sk,
+                filter_thres=args.top_k, temperature=args.temperature,
+                cond_scale=args.cond_scale,
+            )
+            from PIL import Image
+
+            for img in np.asarray(images):
+                arr = (np.clip(img, 0, 1) * 255).astype(np.uint8)
+                fp = out_dir / f"{produced}.png"
+                Image.fromarray(arr.squeeze()).save(fp)
+                paths.append(fp)
+                produced += 1
+
+        print(f"created {produced} images at {str(out_dir)}")
+    return paths
+
+
+if __name__ == "__main__":
+    main()

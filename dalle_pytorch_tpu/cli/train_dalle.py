@@ -1,0 +1,336 @@
+"""DALL-E training CLI — parity with /root/reference/train_dalle.py: VAE
+reconstitution from a trained vae checkpoint, resume from a dalle checkpoint,
+tokenizer selection, folder or tar-shard data pipelines, checkpoint rotation,
+save-before-train fail-fast, throughput metric, periodic sample generation —
+with distribution through the mesh backend (pjit sharding + ZeRO stages +
+gradient accumulation + bf16) instead of DeepSpeed/Horovod engines."""
+from __future__ import annotations
+
+import argparse
+import time
+from glob import glob
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
+from dalle_pytorch_tpu.data.loader import TextImageDataset, batch_tar_stream, iterate_batches, iterate_tar_shards
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.sampling import generate_images
+from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+from dalle_pytorch_tpu.parallel import backend as backend_mod
+from dalle_pytorch_tpu.parallel.mesh import MeshConfig
+from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState
+from dalle_pytorch_tpu.training.checkpoint import load_checkpoint, rotate_checkpoints, save_checkpoint, to_host
+from dalle_pytorch_tpu.training.logging import MetricLogger
+from dalle_pytorch_tpu.version import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Train DALL-E on text/image pairs")
+    group = parser.add_mutually_exclusive_group(required=False)
+    group.add_argument("--vae_path", type=str, default=None, help="path to trained discrete VAE")
+    group.add_argument("--dalle_path", type=str, default=None, help="path to partially-trained DALL-E to resume")
+    parser.add_argument("--image_text_folder", type=str, required=True,
+                        help="folder of image+text files, or a glob of .tar shards with --wds")
+    parser.add_argument("--wds", action="store_true", help="treat image_text_folder as tar shards")
+    parser.add_argument("--truncate_captions", action="store_true")
+    parser.add_argument("--random_resize_crop_lower_ratio", type=float, default=0.75)
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
+    parser.add_argument("--bf16", action="store_true", help="bf16 compute (TPU-native mixed precision)")
+    parser.add_argument("--wandb", action="store_true")
+    parser.add_argument("--wandb_name", type=str, default="dalle_train_transformer")
+    parser.add_argument("--wandb_entity", type=str, default=None)
+    parser.add_argument("--stable_softmax", action="store_true")
+    # model
+    parser.add_argument("--dim", type=int, default=512)
+    parser.add_argument("--text_seq_len", type=int, default=256)
+    parser.add_argument("--depth", type=int, default=2)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--dim_head", type=int, default=64)
+    parser.add_argument("--reversible", action="store_true")
+    parser.add_argument("--execution", type=str, default=None, choices=[None, "sequential", "remat", "reversible"])
+    parser.add_argument("--loss_img_weight", type=int, default=7)
+    parser.add_argument("--attn_types", type=str, default="full",
+                        help="comma-separated cycle of full,axial_row,axial_col,conv_like,sparse")
+    parser.add_argument("--shift_tokens", help="use token shift", action="store_true")
+    parser.add_argument("--rotary_emb", help="use rotary embeddings", action="store_true")
+    parser.add_argument("--shared_attn_ids", type=str, default=None)
+    parser.add_argument("--shared_ff_ids", type=str, default=None)
+    parser.add_argument("--share_input_output_emb", action="store_true")
+    parser.add_argument("--num_text_tokens", type=int, default=None, help="override tokenizer vocab size")
+    # training
+    parser.add_argument("--epochs", type=int, default=20)
+    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--keep_n_checkpoints", type=int, default=None)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--ga_steps", type=int, default=1, help="gradient accumulation steps")
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--clip_grad_norm", type=float, default=0.5)
+    parser.add_argument("--lr_decay", action="store_true")
+    parser.add_argument("--sample_every_n_steps", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=42)
+    # mesh / ZeRO
+    parser.add_argument("--zero_stage", type=int, default=0, choices=[0, 1, 2, 3])
+    parser.add_argument("--mesh_dp", type=int, default=-1)
+    parser.add_argument("--mesh_fsdp", type=int, default=1)
+    parser.add_argument("--mesh_tp", type=int, default=1)
+    parser.add_argument("--mesh_sp", type=int, default=1)
+    parser.add_argument("--flops_profiler", action="store_true",
+                        help="capture a jax profiler trace around step 200 and stop at 201")
+    return backend_mod.wrap_arg_parser(parser)
+
+
+def get_tokenizer(args):
+    if args.chinese:
+        return tokenizer_mod.ChineseTokenizer()
+    if args.hug:
+        assert args.bpe_path is not None, "--hug requires --bpe_path"
+        return tokenizer_mod.HugTokenizer(args.bpe_path)
+    if args.bpe_path is not None:
+        suffix = Path(args.bpe_path).suffix
+        if suffix == ".json":
+            return tokenizer_mod.HugTokenizer(args.bpe_path)
+        return tokenizer_mod.YttmTokenizer(args.bpe_path)
+    return tokenizer_mod.tokenizer
+
+
+def reconstitute_vae(args):
+    """Load the frozen VAE (weights + config) that tokenizes training images."""
+    assert args.vae_path is not None or args.dalle_path is not None, (
+        "either --vae_path (new run) or --dalle_path (resume) is required"
+    )
+    path = args.vae_path
+    if path is None:
+        # resume: the dalle checkpoint carries vae weights + params
+        trees, meta = load_checkpoint(args.dalle_path)
+        assert "vae_weights" in trees, "resume checkpoint is missing VAE weights"
+        return trees["vae_weights"], DiscreteVAEConfig(**meta["vae_params"])
+    trees, meta = load_checkpoint(path)
+    return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
+
+
+def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
+    save_checkpoint(
+        path,
+        trees={
+            "weights": to_host(state.params),
+            "opt_state": to_host(state.opt_state),
+            "vae_weights": to_host(vae_params),
+        },
+        meta={
+            "hparams": dalle_cfg.to_dict(),
+            "vae_params": vae_cfg.to_dict(),
+            "epoch": epoch,
+            "version": __version__,
+            "vae_class_name": "DiscreteVAE",
+            "scheduler_state": None,
+        },
+    )
+    if keep_n is not None:
+        d = str(Path(path).parent)
+        rotate_checkpoints(d, Path(path).stem + "_step*.npz", keep_n)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    be = backend_mod.set_backend_from_args(args)
+    be.initialize()
+    is_root = be.is_root_worker()
+
+    tokenizer = get_tokenizer(args)
+    vae_params, vae_cfg = reconstitute_vae(args)
+
+    resume_meta = None
+    if args.dalle_path is not None:
+        trees, resume_meta = load_checkpoint(args.dalle_path)
+        dalle_cfg = DALLEConfig(**_tupled(resume_meta["hparams"]))
+        start_params = trees["weights"]
+    else:
+        num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
+        dalle_cfg = DALLEConfig.from_vae(
+            vae_cfg,
+            dim=args.dim,
+            depth=args.depth,
+            num_text_tokens=num_text_tokens,
+            text_seq_len=args.text_seq_len,
+            heads=args.heads,
+            dim_head=args.dim_head,
+            reversible=args.reversible,
+            execution=args.execution,
+            loss_img_weight=args.loss_img_weight,
+            attn_types=tuple(args.attn_types.split(",")),
+            stable=args.stable_softmax,
+            shift_tokens=args.shift_tokens,
+            rotary_emb=args.rotary_emb,
+            shared_attn_ids=_parse_ids(args.shared_attn_ids),
+            shared_ff_ids=_parse_ids(args.shared_ff_ids),
+            share_input_output_emb=args.share_input_output_emb,
+        )
+        start_params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), dalle_cfg)
+
+    # data
+    be.check_batch_size(args.batch_size)
+    if args.wds:
+        shards = sorted(glob(args.image_text_folder))
+        assert shards, f"no tar shards match {args.image_text_folder}"
+
+        def data_iter(epoch):
+            stream = iterate_tar_shards(
+                shards, vae_cfg.image_size, dalle_cfg.text_seq_len, tokenizer,
+                truncate_captions=args.truncate_captions,
+                process_index=be.get_rank(), process_count=be.get_world_size(),
+                seed=args.seed + epoch,
+            )
+            return batch_tar_stream(stream, args.batch_size)
+    else:
+        dataset = TextImageDataset(
+            args.image_text_folder,
+            text_len=dalle_cfg.text_seq_len,
+            image_size=vae_cfg.image_size,
+            truncate_captions=args.truncate_captions,
+            resize_ratio=args.random_resize_crop_lower_ratio,
+            tokenizer=tokenizer,
+            shuffle=True,
+        )
+        assert len(dataset) > 0, "dataset is empty"
+
+        def data_iter(epoch):
+            return iterate_batches(
+                dataset, args.batch_size, seed=args.seed + epoch,
+                process_index=be.get_rank(), process_count=be.get_world_size(),
+            )
+
+    # loss: raw pixels -> frozen VAE codes -> DALLE CE loss
+    def loss_fn(params, batch, key):
+        codes = vae_mod.get_codebook_indices(vae_params, vae_cfg, batch["image"])
+        return dalle_mod.forward(
+            params, dalle_cfg, batch["text"], jax.lax.stop_gradient(codes),
+            return_loss=True, key=key,
+        )
+
+    lr = optax.exponential_decay(args.learning_rate, 10000, 0.98) if args.lr_decay else args.learning_rate
+    optimizer = optax.adam(lr)
+    settings = StepSettings(
+        grad_accum=args.ga_steps,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        clip_grad_norm=args.clip_grad_norm,
+        zero_stage=args.zero_stage,
+    )
+    mesh_cfg = MeshConfig(args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp)
+    state, step_fn, _, _ = be.distribute(
+        loss_fn=loss_fn, params=start_params, optimizer=optimizer,
+        mesh_config=mesh_cfg, settings=settings,
+    )
+    if resume_meta is not None and "opt_state" in trees:
+        state = TrainState(state.step, state.params, jax.tree_util.tree_map(
+            lambda cur, saved: jnp.asarray(saved).astype(cur.dtype) if hasattr(cur, "dtype") else saved,
+            state.opt_state, trees["opt_state"],
+        ))
+
+    logger = MetricLogger(
+        run_name=args.dalle_output_file_name, use_wandb=args.wandb,
+        wandb_kwargs={"name": args.wandb_name, "entity": args.wandb_entity},
+        config=dalle_cfg.to_dict(), is_root=is_root,
+    )
+
+    out_file = f"{args.dalle_output_file_name}.pt"
+    start_epoch = (resume_meta or {}).get("epoch", 0)
+
+    # save-before-train fail-fast (reference train_dalle.py:591-594)
+    if is_root:
+        save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, start_epoch)
+
+    key = jax.random.PRNGKey(args.seed + 1)
+    global_step = 0
+    for epoch in range(start_epoch, args.epochs):
+        t_window = time.time()
+        for batch in data_iter(epoch):
+            key, sk = jax.random.split(key)
+            device_batch = {
+                "text": jnp.asarray(batch["text"]),
+                "image": jnp.asarray(batch["image"]),
+            }
+            state, metrics = step_fn(state, device_batch, sk)
+
+            if global_step % 10 == 0:
+                dt = time.time() - t_window
+                sample_per_sec = args.batch_size * 10 / dt if global_step else 0.0
+                t_window = time.time()
+                logger.log(
+                    {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch,
+                     "sample_per_sec": sample_per_sec},
+                    step=global_step,
+                )
+            if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and is_root:
+                step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
+                save_model(step_file, state, dalle_cfg, vae_params, vae_cfg, epoch,
+                           keep_n=args.keep_n_checkpoints)
+            if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
+                _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
+            if args.flops_profiler:
+                if global_step == 199:
+                    jax.profiler.start_trace("./profile_trace")
+                if global_step == 200:
+                    jax.profiler.stop_trace()
+                    print("profiler trace written to ./profile_trace; stopping (parity with --flops_profiler)")
+                    logger.finish()
+                    return state, dalle_cfg
+            global_step += 1
+
+        if is_root:
+            save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, epoch + 1)
+
+    if is_root:
+        save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, args.epochs)
+    logger.finish()
+    return state, dalle_cfg
+
+
+def _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, batch, tokenizer, step):
+    try:
+        text = batch["text"][:1]
+        images = generate_images(
+            state.params, dalle_cfg, vae_params, vae_cfg, text, jax.random.PRNGKey(step)
+        )
+        arr = np.asarray(images[0])
+        caption = tokenizer.decode(np.asarray(text[0]))
+        logger.log({"sample_caption": caption, "sample_min": float(arr.min()),
+                    "sample_max": float(arr.max())}, step=step, quiet=True)
+        try:
+            from PIL import Image
+
+            Path("samples").mkdir(exist_ok=True)
+            arr8 = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
+            Image.fromarray(arr8.squeeze()).save(f"samples/step{step}.png")
+        except Exception:
+            pass
+    except Exception as e:  # sampling must never kill training
+        print(f"[sample] generation failed: {e!r}")
+
+
+def _parse_ids(s):
+    if s is None:
+        return None
+    return tuple(int(x) for x in s.split(","))
+
+
+def _tupled(hparams: dict) -> dict:
+    out = dict(hparams)
+    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
+        if out.get(k) is not None:
+            out[k] = tuple(out[k])
+    return out
+
+
+if __name__ == "__main__":
+    main()

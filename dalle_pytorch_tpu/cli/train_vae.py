@@ -1,0 +1,161 @@
+"""Discrete VAE training CLI — parity with /root/reference/train_vae.py
+(flags, temperature annealing every 100 steps, checkpointing a plain file
+with {hparams, weights}, codebook-usage logging), running as a jitted TPU
+train step with the temperature as a traced scalar (no recompiles while
+annealing)."""
+from __future__ import annotations
+
+import argparse
+import math
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches
+from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+from dalle_pytorch_tpu.parallel import backend as backend_mod
+from dalle_pytorch_tpu.training.checkpoint import save_checkpoint, to_host
+from dalle_pytorch_tpu.training.logging import MetricLogger
+from dalle_pytorch_tpu.version import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Train the discrete VAE image tokenizer")
+    parser.add_argument("--image_folder", type=str, required=True)
+    parser.add_argument("--image_size", type=int, default=128)
+    parser.add_argument("--num_tokens", type=int, default=8192)
+    parser.add_argument("--num_layers", type=int, default=3)
+    parser.add_argument("--num_resnet_blocks", type=int, default=2)
+    parser.add_argument("--smooth_l1_loss", action="store_true")
+    parser.add_argument("--emb_dim", type=int, default=512)
+    parser.add_argument("--hidden_dim", type=int, default=256)
+    parser.add_argument("--kl_loss_weight", type=float, default=0.0)
+    parser.add_argument("--transparent", action="store_true")
+    parser.add_argument("--straight_through", action="store_true")
+    parser.add_argument("--reinmax", action="store_true")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--epochs", type=int, default=20)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--lr_decay_rate", type=float, default=0.98)
+    parser.add_argument("--starting_temp", type=float, default=1.0)
+    parser.add_argument("--temp_min", type=float, default=0.5)
+    parser.add_argument("--anneal_rate", type=float, default=1e-6)
+    parser.add_argument("--num_images_save", type=int, default=4)
+    parser.add_argument("--vae_output_file_name", type=str, default="vae")
+    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
+    parser.add_argument("--wandb_name", type=str, default="dalle_train_vae")
+    return backend_mod.wrap_arg_parser(parser)
+
+
+def save_model(path: str, params, cfg: DiscreteVAEConfig):
+    save_checkpoint(
+        path,
+        trees={"weights": to_host(params)},
+        meta={"hparams": cfg.to_dict(), "version": __version__},
+    )
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    be = backend_mod.set_backend_from_args(args)
+    be.initialize()
+    is_root = be.is_root_worker()
+
+    cfg = DiscreteVAEConfig(
+        image_size=args.image_size,
+        num_tokens=args.num_tokens,
+        codebook_dim=args.emb_dim,
+        num_layers=args.num_layers,
+        num_resnet_blocks=args.num_resnet_blocks,
+        hidden_dim=args.hidden_dim,
+        channels=4 if args.transparent else 3,
+        smooth_l1_loss=args.smooth_l1_loss,
+        temperature=args.starting_temp,
+        straight_through=args.straight_through,
+        reinmax=args.reinmax,
+        kl_div_loss_weight=args.kl_loss_weight,
+    )
+
+    dataset = ImageDataset(args.image_folder, args.image_size, transparent=args.transparent)
+    assert len(dataset) > 0, f"no images found in {args.image_folder}"
+    be.check_batch_size(args.batch_size)
+
+    params = vae_mod.init_discrete_vae(jax.random.PRNGKey(args.seed), cfg)
+    # adam with the lr applied as a traced scalar inside the step, so the
+    # per-epoch ExponentialLR decay (reference train_vae.py:157-158) never
+    # triggers a recompile
+    opt = optax.chain(optax.scale_by_adam(), optax.scale(-1.0))
+    opt_state = opt.init(params)
+    lr = args.learning_rate
+
+    logger = MetricLogger(
+        run_name=args.vae_output_file_name, use_wandb=args.wandb,
+        wandb_kwargs={"name": args.wandb_name}, config=cfg.to_dict(), is_root=is_root,
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, images, key, temp, lr):
+        def loss_fn(p):
+            return vae_mod.forward(p, cfg, images, key=key, return_loss=True, temp=temp)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    @jax.jit
+    def codebook_usage(params, images):
+        idx = vae_mod.get_codebook_indices(params, cfg, images)
+        counts = jnp.bincount(idx.reshape(-1), length=cfg.num_tokens)
+        return jnp.sum(counts > 0)
+
+    # fail fast on unwritable output before burning compute
+    save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+
+    temp = args.starting_temp
+    global_step = 0
+    key = jax.random.PRNGKey(args.seed + 1)
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        for images in iterate_image_batches(
+            dataset, args.batch_size, seed=args.seed + epoch,
+            process_index=be.get_rank(), process_count=be.get_world_size(),
+        ):
+            key, sk = jax.random.split(key)
+            params, opt_state, loss = train_step(
+                params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr)
+            )
+
+            if global_step % 100 == 0:
+                # temperature annealing (reference train_vae.py:276-278)
+                temp = max(temp * math.exp(-args.anneal_rate * global_step), args.temp_min)
+                used = int(codebook_usage(params, jnp.asarray(images)))
+                logger.log(
+                    {"loss": float(loss), "temperature": temp, "lr": lr,
+                     "codebook_used": used, "epoch": epoch},
+                    step=global_step,
+                )
+            if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
+                save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+            global_step += 1
+
+        lr *= args.lr_decay_rate
+        if is_root:
+            save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+            logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
+
+    logger.finish()
+    return params, cfg
+
+
+if __name__ == "__main__":
+    main()

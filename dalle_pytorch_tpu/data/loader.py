@@ -162,6 +162,45 @@ def iterate_batches(
         epoch += 1
 
 
+class ImageDataset:
+    """Images-only folder dataset (the reference's train_vae.py uses
+    torchvision ImageFolder; class labels are irrelevant for the VAE)."""
+
+    def __init__(self, folder: str, image_size: int, seed: int = 0, transparent: bool = False):
+        path = Path(folder)
+        self.files = sorted(
+            f for suffix in IMAGE_SUFFIXES for f in path.glob(f"**/*{suffix}")
+        )
+        self.image_size = image_size
+        self.mode = "RGBA" if transparent else "RGB"
+        self._rng = random.Random(seed)
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def __getitem__(self, ind: int) -> np.ndarray:
+        img = Image.open(self.files[ind])
+        img = random_resized_crop(img.convert(self.mode), self.image_size, self._rng)
+        return _image_to_array(img, self.mode)
+
+
+def iterate_image_batches(
+    dataset: ImageDataset,
+    batch_size: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[np.ndarray]:
+    n = len(dataset)
+    order = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(order)
+    order = order[process_index::process_count]
+    for i in range(0, len(order) - batch_size + 1, batch_size):
+        yield np.stack([dataset[int(j)] for j in order[i : i + batch_size]])
+
+
 # --- tar-shard (webdataset-style) pipeline ---------------------------------
 
 def _warn_and_continue(exn: Exception, name: str):

@@ -1,0 +1,76 @@
+"""Checkpointing.
+
+Single-file format with the reference payload layout
+(/root/reference/train_dalle.py:535-582): named pytrees (weights, opt_state,
+scheduler_state) plus JSON metadata (hparams, vae_params, epoch, version,
+vae_class_name).  Arrays are stored host-side in one .npz — sharded arrays are
+gathered transparently by np.asarray, and restore re-shards onto whatever mesh
+the restore step uses, which kills the reference's dual plain/DeepSpeed format
+problem (SURVEY.md §5).
+
+Checkpoint rotation (`keep_n_checkpoints`) matches train_dalle.py:547-550.
+For very large multi-host runs, orbax can replace the npz container behind
+the same API (save/load names + meta)."""
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import re
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> None:
+    """trees: named pytrees of arrays; meta: JSON-serializable metadata."""
+    payload = {"__meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
+    for name, tree in trees.items():
+        if tree is None:
+            continue
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        payload[f"__treedef_{name}"] = np.frombuffer(pickle.dumps(treedef), dtype=np.uint8)
+        for i, leaf in enumerate(leaves):
+            payload[f"{name}:{i}"] = np.asarray(leaf)
+    path = str(path)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Returns (trees, meta)."""
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(bytes(data["__meta"]).decode())
+        names = {
+            k[len("__treedef_") :] for k in data.files if k.startswith("__treedef_")
+        }
+        trees = {}
+        for name in names:
+            treedef = pickle.loads(bytes(data[f"__treedef_{name}"]))
+            n = treedef.num_leaves
+            leaves = [data[f"{name}:{i}"] for i in range(n)]
+            trees[name] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return trees, meta
+
+
+def rotate_checkpoints(directory: str, pattern: str, keep_n: Optional[int]) -> None:
+    """Delete the oldest checkpoints matching `pattern` (a glob) so at most
+    keep_n remain."""
+    if keep_n is None or keep_n <= 0:
+        return
+    files = sorted(Path(directory).glob(pattern), key=lambda p: p.stat().st_mtime)
+    for old in files[:-keep_n]:
+        old.unlink()
+
+
+def to_host(tree: Any) -> Any:
+    """Fully materialize a (possibly sharded) pytree on host."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

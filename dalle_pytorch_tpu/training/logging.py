@@ -1,0 +1,64 @@
+"""Metrics logging: wandb when available and requested (capability parity
+with the reference's W&B instrumentation, SURVEY.md §5), always mirrored to
+stdout + a JSONL file so headless runs keep observability."""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+
+class MetricLogger:
+    def __init__(self, run_name: str = "run", log_dir: str = ".", use_wandb: bool = False,
+                 wandb_kwargs: Optional[dict] = None, config: Optional[dict] = None,
+                 is_root: bool = True):
+        self.is_root = is_root
+        self._wandb = None
+        self._file = None
+        if not is_root:
+            return
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(config=config or {}, **(wandb_kwargs or {}))
+            except Exception as e:  # pragma: no cover
+                print(f"[logging] wandb unavailable ({e!r}); falling back to JSONL")
+        path = Path(log_dir) / f"{run_name}.metrics.jsonl"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        self._file = open(path, "a")
+
+    def log(self, metrics: Dict[str, Any], step: Optional[int] = None, quiet: bool = False):
+        if not self.is_root:
+            return
+        record = {"ts": time.time(), **({"step": step} if step is not None else {}), **metrics}
+        if self._file is not None:
+            self._file.write(json.dumps({k: _jsonable(v) for k, v in record.items()}) + "\n")
+            self._file.flush()
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+        if not quiet:
+            parts = " ".join(f"{k}={_fmt(v)}" for k, v in metrics.items())
+            print(f"[{step}] {parts}" if step is not None else parts, flush=True)
+
+    def finish(self):
+        if self._file is not None:
+            self._file.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return float(v)
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return v

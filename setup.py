@@ -1,0 +1,40 @@
+from setuptools import find_packages, setup
+
+exec(open("dalle_pytorch_tpu/version.py").read())
+
+setup(
+    name="dalle-pytorch-tpu",
+    packages=find_packages(exclude=["tests"]),
+    include_package_data=True,
+    package_data={"dalle_pytorch_tpu": ["data/vocab/*.txt"]},
+    version=__version__,  # noqa: F821
+    license="MIT",
+    description="TPU-native (JAX/XLA/Pallas) DALL-E: discrete VAE, text-to-image transformer, CLIP reranking",
+    long_description_content_type="text/markdown",
+    keywords=[
+        "artificial intelligence",
+        "attention mechanism",
+        "transformers",
+        "text-to-image",
+        "tpu",
+        "jax",
+    ],
+    install_requires=[
+        "jax",
+        "numpy",
+        "optax",
+        "regex",
+        "Pillow",
+    ],
+    extras_require={
+        "tokenizers": ["tokenizers", "transformers", "youtokentome", "ftfy"],
+        "logging": ["wandb"],
+    },
+    classifiers=[
+        "Development Status :: 4 - Beta",
+        "Intended Audience :: Developers",
+        "Topic :: Scientific/Engineering :: Artificial Intelligence",
+        "License :: OSI Approved :: MIT License",
+        "Programming Language :: Python :: 3.10",
+    ],
+)

@@ -1,0 +1,140 @@
+"""End-to-end CLI tests on a synthetic colored-shapes dataset (the JAX-native
+version of the reference's rainbow_dalle.ipynb fixture, SURVEY.md §4)."""
+import numpy as np
+import pytest
+from PIL import Image, ImageDraw
+
+from dalle_pytorch_tpu.cli import generate as generate_cli
+from dalle_pytorch_tpu.cli import train_dalle as train_dalle_cli
+from dalle_pytorch_tpu.cli import train_vae as train_vae_cli
+
+COLORS = {"red": (220, 40, 40), "green": (40, 200, 60), "blue": (50, 80, 220)}
+SHAPES = ("circle", "square")
+
+
+def make_rainbow_dataset(folder, n=24, size=16):
+    folder.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        color = list(COLORS)[i % len(COLORS)]
+        shape = SHAPES[(i // len(COLORS)) % len(SHAPES)]
+        img = Image.new("RGB", (size, size), (250, 250, 250))
+        d = ImageDraw.Draw(img)
+        x0, y0 = rng.randint(1, 6), rng.randint(1, 6)
+        x1, y1 = x0 + rng.randint(6, 9), y0 + rng.randint(6, 9)
+        if shape == "circle":
+            d.ellipse([x0, y0, x1, y1], fill=COLORS[color])
+        else:
+            d.rectangle([x0, y0, x1, y1], fill=COLORS[color])
+        img.save(folder / f"img{i:03d}.png")
+        (folder / f"img{i:03d}.txt").write_text(f"a {color} {shape}")
+
+
+@pytest.fixture(scope="module")
+def workspace(tmp_path_factory):
+    ws = tmp_path_factory.mktemp("rainbow")
+    make_rainbow_dataset(ws / "data")
+    return ws
+
+
+@pytest.fixture(scope="module")
+def trained_vae(workspace):
+    params, cfg = train_vae_cli.main([
+        "--image_folder", str(workspace / "data"),
+        "--image_size", "16",
+        "--num_tokens", "32",
+        "--num_layers", "2",
+        "--emb_dim", "16",
+        "--hidden_dim", "16",
+        "--num_resnet_blocks", "0",
+        "--epochs", "1",
+        "--batch_size", "8",
+        "--vae_output_file_name", str(workspace / "vae"),
+        "--save_every_n_steps", "0",
+    ])
+    assert (workspace / "vae.pt").exists()
+    return workspace / "vae.pt"
+
+
+@pytest.fixture(scope="module")
+def trained_dalle(workspace, trained_vae):
+    state, cfg = train_dalle_cli.main([
+        "--vae_path", str(trained_vae),
+        "--image_text_folder", str(workspace / "data"),
+        "--dim", "32",
+        "--depth", "1",
+        "--heads", "2",
+        "--dim_head", "8",
+        "--text_seq_len", "16",
+        "--num_text_tokens", "64",
+        "--epochs", "1",
+        "--batch_size", "8",
+        "--save_every_n_steps", "0",
+        "--sample_every_n_steps", "0",
+        "--dalle_output_file_name", str(workspace / "dalle"),
+        "--truncate_captions",
+        "--rotary_emb",
+        "--shift_tokens",
+    ])
+    assert (workspace / "dalle.pt").exists()
+    return workspace / "dalle.pt"
+
+
+def test_train_vae_cli(trained_vae):
+    from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+    trees, meta = load_checkpoint(str(trained_vae))
+    assert "weights" in trees
+    assert meta["hparams"]["num_tokens"] == 32
+    assert "version" in meta
+
+
+def test_train_dalle_cli_and_checkpoint_payload(trained_dalle):
+    from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+    trees, meta = load_checkpoint(str(trained_dalle))
+    # reference checkpoint payload parity (train_dalle.py:535-582)
+    for k in ("hparams", "vae_params", "epoch", "version", "vae_class_name", "scheduler_state"):
+        assert k in meta, k
+    assert "weights" in trees and "opt_state" in trees and "vae_weights" in trees
+    assert meta["vae_class_name"] == "DiscreteVAE"
+
+
+def test_train_dalle_resume(workspace, trained_dalle):
+    state, cfg = train_dalle_cli.main([
+        "--dalle_path", str(trained_dalle),
+        "--image_text_folder", str(workspace / "data"),
+        "--epochs", "2",  # resumes from epoch 1
+        "--batch_size", "8",
+        "--save_every_n_steps", "0",
+        "--sample_every_n_steps", "0",
+        "--dalle_output_file_name", str(workspace / "dalle_resumed"),
+        "--truncate_captions",
+    ])
+    assert (workspace / "dalle_resumed.pt").exists()
+
+
+def test_generate_cli(workspace, trained_dalle):
+    paths = generate_cli.main([
+        "--dalle_path", str(trained_dalle),
+        "--text", "a red circle|a blue square",
+        "--num_images", "2",
+        "--batch_size", "2",
+        "--outputs_dir", str(workspace / "outputs"),
+    ])
+    assert len(paths) == 4
+    for p in paths:
+        img = Image.open(p)
+        assert img.size == (16, 16)
+
+
+def test_generate_cli_gentxt(workspace, trained_dalle):
+    paths = generate_cli.main([
+        "--dalle_path", str(trained_dalle),
+        "--text", "a red",
+        "--gentxt",
+        "--num_images", "1",
+        "--batch_size", "1",
+        "--outputs_dir", str(workspace / "outputs_gentxt"),
+    ])
+    assert len(paths) == 1

@@ -1,0 +1,6 @@
+#!/usr/bin/env python
+"""Shim: `python train_vae.py ...` (same entry-point shape as the reference)."""
+from dalle_pytorch_tpu.cli.train_vae import main
+
+if __name__ == "__main__":
+    main()
