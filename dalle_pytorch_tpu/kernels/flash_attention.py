@@ -4,14 +4,14 @@ The memory-linear attention kernel for the `full` (and pattern-masked)
 attention paths: blockwise online-softmax accumulation in VMEM, never
 materializing the (n, n) score matrix in HBM.  This is the TPU replacement
 for the reference's DeepSpeed/Triton sparse CUDA kernels
-(/root/reference/attention.py:339-398) and the dense einsum path — block
-sparsity shows up here as *skipped tiles*: causally-dead tiles and tiles whose
-pattern-mask block is all-False are never computed.
+(/root/reference/dalle_pytorch/attention.py:339-398) and the dense einsum
+path — block sparsity shows up here as *skipped tiles*: causally-dead tiles
+are never computed, and pattern masks are applied tile-by-tile.
 
-Backward pass: jax.custom_vjp with flash recomputation expressed in XLA ops
-(block remat) — the forward saves only (out, logsumexp), O(n) memory.  A full
-Pallas backward kernel is a planned optimization; the fwd kernel is where the
-HBM savings live.
+Backward pass: jax.custom_vjp recomputing the softmax in XLA ops from the
+saved (q, k, v) — O(n·d) residual memory instead of O(n²) saved
+probabilities.  A fully-Pallas backward kernel is a planned optimization; the
+forward is where the HBM savings live.
 
 On CPU (tests) the kernel runs in interpret mode automatically.
 """
@@ -22,12 +22,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+_LANES = 128  # TPU lane width: scratch rows are padded to this
 _NEG = -1e30
 
 
@@ -35,7 +35,7 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
                 m_scr, l_scr, acc_scr, *, causal, block_q, block_k, scale, use_mask):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -60,16 +60,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         if use_mask:
             s = jnp.where(mask_ref[:], s, _NEG)
 
-        m_prev = m_scr[:]
+        m_prev = m_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        m_scr[:] = m_cur
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
         # skip tiles strictly above the diagonal
@@ -79,14 +80,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k):
-    """q, k, v: (bh, n, d); mask: (n, n) bool or None.
-    Returns (out (bh, n, d), lse (bh, n))."""
+    """q, k, v: (bh, n, d); mask: (n, n) bool or None.  Returns out (bh, n, d)."""
     bh, n, d = q.shape
     assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
     nq, nk = n // block_q, n // block_k
@@ -101,37 +100,34 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k):
         in_specs.append(pl.BlockSpec((block_q, block_k), lambda b, i, j: (i, j)))
         args = (q, k, v, mask)
     else:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # dummy
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # dummy scalar
         args = (q, k, v, jnp.zeros((1,), jnp.int32))
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
         scale=scale, use_mask=use_mask,
     )
-    out, lse = pl.pallas_call(
+    flops = 2 * 2 * bh * n * n * d * (0.5 if causal else 1.0)
+    return pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
-        ),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(flops), bytes_accessed=int(3 * bh * n * d * 4), transcendentals=int(bh * n * n),
+        ),
         interpret=_interpret(),
     )(*args)
-    return out, lse
 
 
-def _dense_recompute_grads(q, k, v, mask, causal, scale, out, lse, do):
-    """Backward via recomputation with the saved logsumexp (memory O(n))."""
+def _dense_recompute_grads(q, k, v, mask, causal, scale, do):
+    """Backward via full softmax recomputation (O(n²) transient, fused by XLA)."""
     f32 = jnp.float32
     s = jnp.einsum("bid,bjd->bij", q.astype(f32) * scale, k.astype(f32))
     n = q.shape[1]
@@ -141,11 +137,12 @@ def _dense_recompute_grads(q, k, v, mask, causal, scale, out, lse, do):
         s = jnp.where(j_pos <= i_pos, s, _NEG)
     if mask is not None:
         s = jnp.where(mask[None], s, _NEG)
-    p = jnp.exp(s - lse[..., None])  # exact softmax probabilities
+    p = jax.nn.softmax(s, axis=-1)
     do32 = do.astype(f32)
     dv = jnp.einsum("bij,bid->bjd", p, do32)
     dp = jnp.einsum("bid,bjd->bij", do32, v.astype(f32))
-    delta = jnp.sum(do32 * out.astype(f32), axis=-1, keepdims=True)
+    out = jnp.einsum("bij,bjd->bid", p, v.astype(f32))
+    delta = jnp.sum(do32 * out, axis=-1, keepdims=True)
     ds = p * (dp - delta)
     dq = jnp.einsum("bij,bjd->bid", ds, k.astype(f32)) * scale
     dk = jnp.einsum("bij,bid->bjd", ds, q.astype(f32)) * scale
@@ -154,18 +151,17 @@ def _dense_recompute_grads(q, k, v, mask, causal, scale, out, lse, do):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, mask, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
-    return out
+    return _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
 
 
 def _flash_vjp_fwd(q, k, v, mask, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
-    return out, (q, k, v, mask, out, lse)
+    out = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
+    return out, (q, k, v, mask)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, mask, out, lse = res
-    dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, out, lse, do)
+    q, k, v, mask = res
+    dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, do)
     return dq, dk, dv, None
 
 

@@ -54,6 +54,7 @@ class DALLEConfig:
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
+    attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
 
     # -- derived ----------------------------------------------------------
     @property
@@ -100,6 +101,7 @@ class DALLEConfig:
             conv_kernel_size=self.conv_kernel_size,
             conv_dilation=self.conv_dilation,
             sparse_block_size=self.sparse_block_size,
+            attn_kernel=self.attn_kernel,
         )
 
     def to_dict(self) -> dict:

@@ -62,6 +62,7 @@ class TransformerConfig:
     shared_attn_ids: Optional[Tuple[int, ...]] = None
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     execution: str = "sequential"  # 'sequential' | 'remat' | 'reversible'
+    attn_kernel: str = "auto"  # 'auto' | 'flash' (Pallas) | 'xla' (dense masked)
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
@@ -191,6 +192,16 @@ def _merge_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(b, n, h * d)
 
 
+def _use_flash(cfg, n: int, key_mask) -> bool:
+    if cfg.attn_kernel == "xla" or key_mask is not None:
+        return False
+    if n % 128 != 0:
+        return False
+    if cfg.attn_kernel == "flash":
+        return True
+    return jax.default_backend() == "tpu"  # 'auto'
+
+
 def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey):
     b, n, _ = x.shape
     qkv = linear(shared["qkv"], x)
@@ -199,6 +210,17 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey):
     if rotary is not None:
         ang = rotary[:n]
         q, k, v = (apply_rotary(ang, t) for t in (q, k, v))
+
+    if _use_flash(cfg, n, key_mask):
+        from dalle_pytorch_tpu.kernels.flash_attention import flash_attention
+
+        pm = pattern[:n, :n] if pattern is not None else None
+        out = flash_attention(
+            q, k, v, mask=pm, causal=cfg.causal, scale=cfg.dim_head ** -0.5
+        )
+        out = linear(shared["out"], _merge_heads(out))
+        return apply_dropout(dkey, out, cfg.attn_dropout)
+
     q = q * (cfg.dim_head ** -0.5)
 
     mask = None
