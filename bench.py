@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Benchmark: DALL-E training-step throughput + MFU on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The measured config is the largest headline-shaped model that trains on a
+single chip (seq=1280 = 256 text + 32x32 image tokens, the reference's
+standard geometry; full+axial+conv attention cycle; bf16 compute; Pallas
+flash attention; remat).  MFU is FLOPs-per-step / peak-chip-FLOPs;
+vs_baseline is MFU / 0.45, the BASELINE.md target ratio."""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak dense bf16 FLOP/s
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def _chip_peak() -> float:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        kind = ""
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind.replace(" ", ""):
+            return val
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return PEAK_BF16_FLOPS.get(gen, 197e12)
+
+
+def _matmul_params(params) -> int:
+    import numpy as np
+
+    return int(
+        sum(
+            x.size
+            for path, x in jax.tree_util.tree_leaves_with_path(params)
+            if getattr(x, "ndim", 0) == 2
+        )
+    )
+
+
+def dalle_step_flops(cfg, batch: int, n_matmul_params: int) -> float:
+    """Analytic FLOPs for one train step (fwd + bwd = 3x fwd matmul cost)."""
+    s = cfg.total_seq_len
+    # projections/ff/logits: 2 * P * tokens per fwd pass
+    proj = 2.0 * n_matmul_params * batch * s
+    # attention scores+values: 2 ops * 2 matmuls * B*H*S^2*dh, causal halves it
+    attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * 0.5
+    attn *= cfg.depth
+    return 3.0 * (proj + attn)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+
+    from dalle_pytorch_tpu.models import dalle as dalle_mod
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+    from dalle_pytorch_tpu.parallel.train_step import StepSettings, make_train_step
+
+    if on_tpu:
+        cfg = DALLEConfig(
+            dim=1024, depth=16, heads=16, dim_head=64,
+            num_text_tokens=10000, text_seq_len=256,
+            num_image_tokens=8192, image_fmap_size=32,
+            attn_types=("full", "axial_row", "axial_col", "conv_like"),
+            shift_tokens=True, rotary_emb=True, execution="remat",
+        )
+        batch = 8
+        steps, warmup = 10, 2
+    else:  # CPU smoke fallback
+        cfg = DALLEConfig(
+            dim=128, depth=2, heads=4, dim_head=32,
+            num_text_tokens=1000, text_seq_len=32,
+            num_image_tokens=512, image_fmap_size=8,
+            shift_tokens=True, rotary_emb=True,
+        )
+        batch = 2
+        steps, warmup = 3, 1
+
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, b, key):
+        return dalle_mod.forward(p, cfg, b["text"], b["image_codes"], return_loss=True)
+
+    settings = StepSettings(compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    init_fn, step_fn = make_train_step(loss_fn, optax.adam(1e-4), settings=settings)
+    state = init_fn(params)
+
+    batch_data = {
+        "text": jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.text_seq_len), 0, cfg.num_text_tokens),
+        "image_codes": jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.image_seq_len), 0, cfg.num_image_tokens),
+    }
+
+    n_matmul = _matmul_params(state.params)
+
+    for i in range(warmup):
+        state, metrics = step_fn(state, batch_data, jax.random.PRNGKey(i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, batch_data, jax.random.PRNGKey(100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    step_time = dt / steps
+    img_tok_per_sec = batch * cfg.image_seq_len / step_time
+    flops = dalle_step_flops(cfg, batch, n_matmul)
+    mfu = flops / step_time / _chip_peak()
+
+    print(json.dumps({
+        "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280)" if on_tpu
+                  else "img-tokens/sec/chip (CPU smoke)",
+        "value": round(img_tok_per_sec, 1),
+        "unit": "img-tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "step_time_s": round(step_time, 4),
+        "params_million": round(sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1),
+        "batch": batch,
+        "loss": float(metrics["loss"]),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

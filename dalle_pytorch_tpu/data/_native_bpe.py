@@ -1,0 +1,59 @@
+"""ctypes binding for the C++ BPE merge engine (native/bpe.cpp).
+
+Build the shared library with `make -C native` (or
+`g++ -O2 -shared -fPIC -o native/_libbpe.so native/bpe.cpp`).  The library is
+searched next to this file and in the repo's native/ directory.  Pure-Python
+BPE (tokenizer.SimpleTokenizer._merge_word) is the always-available fallback
+and the correctness oracle."""
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import List
+
+_LIB_NAMES = ("_libbpe.so",)
+
+
+def _find_library() -> str:
+    here = Path(__file__).resolve().parent
+    candidates = [here / name for name in _LIB_NAMES]
+    candidates += [here.parent.parent / "native" / name for name in _LIB_NAMES]
+    for c in candidates:
+        if c.exists():
+            return str(c)
+    raise FileNotFoundError("native BPE library not built (make -C native)")
+
+
+class NativeBPE:
+    def __init__(self, merges_path: str):
+        self._lib = ctypes.CDLL(_find_library())
+        self._lib.bpe_create.restype = ctypes.c_void_p
+        self._lib.bpe_create.argtypes = [ctypes.c_char_p]
+        self._lib.bpe_encode_word.restype = ctypes.c_int
+        self._lib.bpe_encode_word.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        self._lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+        self._handle = self._lib.bpe_create(merges_path.encode())
+        if not self._handle:
+            raise RuntimeError(f"bpe_create failed for {merges_path}")
+        self._buf = (ctypes.c_int32 * 4096)()
+
+    def encode_word(self, mapped_word: str) -> List[int]:
+        """mapped_word: a pre-tokenized word already passed through the
+        byte->unicode alphabet (tokenizer.py)."""
+        n = self._lib.bpe_encode_word(
+            self._handle, mapped_word.encode("utf-8"), self._buf, len(self._buf)
+        )
+        if n < 0:
+            raise RuntimeError(f"native BPE error {n} for {mapped_word!r}")
+        return list(self._buf[:n])
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.bpe_destroy(self._handle)
+        except Exception:
+            pass

@@ -149,12 +149,13 @@ class SimpleTokenizer:
 
     def encode(self, text: str) -> List[int]:
         text = _clean_text(text).lower()
-        if self._native is not None:
-            return self._native.encode(text)
         ids: List[int] = []
         for word in self._pattern.findall(text):
             mapped = "".join(self.byte_encoder[b] for b in word.encode("utf-8"))
-            ids.extend(self.encoder[sym] for sym in self._merge_word(mapped))
+            if self._native is not None:
+                ids.extend(self._native.encode_word(mapped))
+            else:
+                ids.extend(self.encoder[sym] for sym in self._merge_word(mapped))
         return ids
 
     def decode(self, tokens, remove_start_end: bool = True, pad_tokens: Set[int] = frozenset()):

@@ -1,0 +1,262 @@
+"""OpenAI pretrained discrete VAE — JAX port.
+
+Parity with the reference's OpenAIDiscreteVAE wrapper
+(/root/reference/dalle_pytorch/vae.py:111-143), which loads OpenAI's pickled
+torch modules.  Here the architecture (the public DALL-E dVAE: 7x7 input
+conv, 4 groups of residual blocks with 4-layer conv paths, maxpool
+downsampling / nearest-neighbour upsampling, logit-laplace output) is
+re-implemented as JAX functions, and the published torch weights are
+converted ONCE into a plain pytree (torch is only imported inside the
+converter).  map_pixels / unmap_pixels use the same eps=0.1 transform.
+
+Geometry: image_size 256, num_layers 3 (f8 -> 32x32 grid), num_tokens 8192,
+channels 3.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LOGIT_LAPLACE_EPS = 0.1
+
+OPENAI_VAE_ENCODER_URL = "https://cdn.openai.com/dall-e/encoder.pkl"
+OPENAI_VAE_DECODER_URL = "https://cdn.openai.com/dall-e/decoder.pkl"
+
+GROUP_COUNT = 4
+N_BLK_PER_GROUP = 2
+N_HID = 256
+VOCAB = 8192
+
+
+class OpenAIVAEConfig:
+    """Static facts about the OpenAI dVAE (mirrors the wrapper attributes)."""
+
+    image_size = 256
+    num_layers = 3
+    num_tokens = 8192
+    channels = 3
+    codebook_dim = None  # codes live in logit space; decode is one-hot conv
+
+    @property
+    def fmap_size(self):
+        return self.image_size // (2 ** self.num_layers)
+
+    @property
+    def image_seq_len(self):
+        return self.fmap_size ** 2
+
+    def to_dict(self):
+        return {"class": "OpenAIDiscreteVAE"}
+
+
+def map_pixels(x: jnp.ndarray, eps: float = LOGIT_LAPLACE_EPS) -> jnp.ndarray:
+    return (1 - 2 * eps) * x + eps
+
+
+def unmap_pixels(x: jnp.ndarray, eps: float = LOGIT_LAPLACE_EPS) -> jnp.ndarray:
+    return jnp.clip((x - eps) / (1 - 2 * eps), 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# architecture (NHWC)
+# ---------------------------------------------------------------------------
+
+def _conv(p: Dict, x: jnp.ndarray, stride: int = 1) -> jnp.ndarray:
+    k = p["w"].shape[0]
+    pad = (k - 1) // 2
+    y = jax.lax.conv_general_dilated(
+        x, p["w"].astype(x.dtype), (stride, stride), ((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["b"].astype(y.dtype)
+
+
+def _res_block(p: Dict, x: jnp.ndarray) -> jnp.ndarray:
+    """OpenAI dVAE block: id path (1x1 conv when widening, else identity) +
+    [relu conv3]x3 + relu conv1."""
+    idp = _conv(p["id"], x) if "id" in p else x
+    h = _conv(p["c1"], jax.nn.relu(x))
+    h = _conv(p["c2"], jax.nn.relu(h))
+    h = _conv(p["c3"], jax.nn.relu(h))
+    h = _conv(p["c4"], jax.nn.relu(h))
+    return idp + h
+
+
+def _max_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def _upsample(x: jnp.ndarray) -> jnp.ndarray:
+    b, h, w, c = x.shape
+    return jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, 2, w, 2, c)).reshape(b, 2 * h, 2 * w, c)
+
+
+def encoder_apply(params: Dict, images: jnp.ndarray) -> jnp.ndarray:
+    """images (B, 256, 256, 3) in [0,1] -> logits (B, 32, 32, 8192)."""
+    x = map_pixels(images)
+    x = _conv(params["input"], x)
+    for g, group in enumerate(params["groups"]):
+        for blk in group:
+            x = _res_block(blk, x)
+        if g < GROUP_COUNT - 1:
+            x = _max_pool(x)
+    return _conv(params["output"], jax.nn.relu(x))
+
+
+def decoder_apply(params: Dict, z_onehot: jnp.ndarray) -> jnp.ndarray:
+    """z_onehot (B, 32, 32, 8192) -> images (B, 256, 256, 3) in [0,1]."""
+    x = _conv(params["input"], z_onehot)
+    for g, group in enumerate(params["groups"]):
+        for blk in group:
+            x = _res_block(blk, x)
+        if g < GROUP_COUNT - 1:
+            x = _upsample(x)
+    x = _conv(params["output"], jax.nn.relu(x))
+    return unmap_pixels(jax.nn.sigmoid(x[..., :3]))
+
+
+def get_codebook_indices(params: Dict, cfg: OpenAIVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
+    logits = encoder_apply(params["encoder"], images)
+    return jnp.argmax(logits, axis=-1).reshape(images.shape[0], -1)
+
+
+def decode_indices(params: Dict, cfg: OpenAIVAEConfig, img_seq: jnp.ndarray) -> jnp.ndarray:
+    b, n = img_seq.shape
+    hw = int(math.isqrt(n))
+    z = jax.nn.one_hot(img_seq, VOCAB, dtype=jnp.float32).reshape(b, hw, hw, VOCAB)
+    return decoder_apply(params["decoder"], z)
+
+
+# ---------------------------------------------------------------------------
+# weight conversion (torch pickle -> pytree)
+# ---------------------------------------------------------------------------
+
+def _convert_conv(state: Dict, prefix: str) -> Dict:
+    """torch Conv2d weight (out, in, kh, kw) -> HWIO + bias.  The OpenAI
+    blocks store convs under `{prefix}.w` / `{prefix}.b`."""
+    for wkey, bkey in ((f"{prefix}.w", f"{prefix}.b"), (f"{prefix}.weight", f"{prefix}.bias")):
+        if wkey in state:
+            w = np.asarray(state[wkey], dtype=np.float32)
+            b = np.asarray(state[bkey], dtype=np.float32).reshape(-1)
+            return {"w": np.transpose(w, (2, 3, 1, 0)), "b": b}
+    raise KeyError(f"no conv weights under {prefix}")
+
+
+def _convert_half(state: Dict, side: str) -> Dict:
+    """Convert one of encoder/decoder from the OpenAI state dict naming:
+    blocks.input.{w,b}; blocks.group_{g}.block_{i}.{id_path|res_path.N}.{w,b};
+    blocks.output.conv.{w,b} (encoder) / blocks.output.{w,b}."""
+    def conv(prefix):
+        return _convert_conv(state, prefix)
+
+    groups = []
+    widen_first = {  # whether block 0 of each group changes width
+        "encoder": [False, True, True, True],
+        "decoder": [False, True, True, True],
+    }[side]
+    for g in range(GROUP_COUNT):
+        group = []
+        for i in range(N_BLK_PER_GROUP):
+            prefix = f"blocks.group_{g + 1}.block_{i + 1}"
+            blk = {
+                "c1": conv(f"{prefix}.res_path.conv_1"),
+                "c2": conv(f"{prefix}.res_path.conv_2"),
+                "c3": conv(f"{prefix}.res_path.conv_3"),
+                "c4": conv(f"{prefix}.res_path.conv_4"),
+            }
+            try:
+                blk["id"] = conv(f"{prefix}.id_path")
+            except KeyError:
+                pass
+            group.append(blk)
+        groups.append(group)
+
+    inp = conv("blocks.input")
+    try:
+        out = conv("blocks.output.conv")
+    except KeyError:
+        out = conv("blocks.output")
+    return {"input": inp, "groups": groups, "output": out}
+
+
+def convert_openai_state_dicts(encoder_state: Dict, decoder_state: Dict) -> Dict:
+    """Build the params pytree from the two torch state dicts (tensor values
+    may be torch tensors or numpy arrays)."""
+    encoder_state = {k: _np(v) for k, v in encoder_state.items()}
+    decoder_state = {k: _np(v) for k, v in decoder_state.items()}
+    return {
+        "encoder": _convert_half(encoder_state, "encoder"),
+        "decoder": _convert_half(decoder_state, "decoder"),
+    }
+
+
+def _np(v):
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def load_openai_vae(encoder_path: str, decoder_path: str) -> Dict:
+    """Load the published encoder.pkl / decoder.pkl (torch pickles of full
+    modules) and convert.  Requires torch at conversion time only."""
+    import torch
+
+    enc = torch.load(encoder_path, map_location="cpu", weights_only=False)
+    dec = torch.load(decoder_path, map_location="cpu", weights_only=False)
+    return convert_openai_state_dicts(enc.state_dict(), dec.state_dict())
+
+
+def init_random_like(key: jax.Array) -> Dict:
+    """Randomly-initialized params with the exact OpenAI dVAE layout (used by
+    tests and for offline smoke runs; real use converts published weights)."""
+    from dalle_pytorch_tpu.core.rng import KeyChain
+
+    keys = KeyChain(key)
+
+    def conv(kh, cin, cout):
+        fan = kh * kh * cin
+        bound = 1.0 / math.sqrt(fan)
+        return {
+            "w": jax.random.uniform(keys.next(), (kh, kh, cin, cout), jnp.float32, -bound, bound),
+            "b": jnp.zeros((cout,), jnp.float32),
+        }
+
+    def block(cin, cout):
+        hid = cout // 4
+        blk = {
+            "c1": conv(3, cin, hid),
+            "c2": conv(3, hid, hid),
+            "c3": conv(3, hid, hid),
+            "c4": conv(1, hid, cout),
+        }
+        if cin != cout:
+            blk["id"] = conv(1, cin, cout)
+        return blk
+
+    def half(widths, k_in, cin0, cout_last, decoder):
+        groups = []
+        cin = widths[0]
+        for g, width in enumerate(widths):
+            group = []
+            for i in range(N_BLK_PER_GROUP):
+                group.append(block(cin, width))
+                cin = width
+            groups.append(group)
+        return {
+            "input": conv(k_in, cin0, widths[0]),
+            "groups": groups,
+            "output": conv(1, widths[-1], cout_last),
+        }
+
+    enc_widths = [N_HID, 2 * N_HID, 4 * N_HID, 8 * N_HID]
+    dec_widths = [8 * N_HID // 2, 4 * N_HID // 2, 2 * N_HID // 2, N_HID // 2]
+    return {
+        "encoder": half(enc_widths, 7, 3, VOCAB, decoder=False),
+        "decoder": half(dec_widths, 1, VOCAB, 6, decoder=True),
+    }

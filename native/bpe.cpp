@@ -1,0 +1,164 @@
+// Native BPE merge engine for the CLIP byte-level tokenizer.
+//
+// The reference's fast-tokenizer option is youtokentome, a C++ BPE library
+// (/root/reference/dalle_pytorch/tokenizer.py:232-266).  This is the
+// framework's in-tree native equivalent: the merge loop — the O(len^2)
+// hot path of encoding — implemented in C++ and called through ctypes
+// (dalle_pytorch_tpu/data/_native_bpe.py).  The Python side keeps the
+// unicode-aware regex pre-tokenization and byte->unicode mapping; words
+// arrive here as UTF-8 strings of mapped codepoints.
+//
+// Build:  g++ -O2 -shared -fPIC -o _libbpe.so bpe.cpp
+//
+// C ABI:
+//   void* bpe_create(const char* merges_path)   — parse merges, build vocab
+//   int   bpe_encode_word(void*, const char* word, int32_t* out, int cap)
+//   void  bpe_destroy(void*)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+    size_t operator()(const std::pair<std::string, std::string>& p) const {
+        std::hash<std::string> h;
+        return h(p.first) * 1000003u ^ h(p.second);
+    }
+};
+
+struct BPE {
+    std::unordered_map<std::string, int32_t> encoder;
+    std::unordered_map<std::pair<std::string, std::string>, int32_t, PairHash> rank;
+};
+
+// encode a unicode codepoint as UTF-8
+std::string cp_to_utf8(uint32_t cp) {
+    std::string s;
+    if (cp < 0x80) {
+        s += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+        s += static_cast<char>(0xC0 | (cp >> 6));
+        s += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+        s += static_cast<char>(0xE0 | (cp >> 12));
+        s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+        s += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+    return s;
+}
+
+// the GPT-2/CLIP byte -> printable-unicode alphabet (matches
+// dalle_pytorch_tpu/data/tokenizer.py::_byte_to_unicode)
+std::vector<std::string> byte_alphabet() {
+    std::vector<bool> visible(256, false);
+    for (int b = '!'; b <= '~'; ++b) visible[b] = true;
+    for (int b = 0xA1; b <= 0xAC; ++b) visible[b] = true;
+    for (int b = 0xAE; b <= 0xFF; ++b) visible[b] = true;
+    std::vector<std::string> out(256);
+    int fill = 0;
+    for (int b = 0; b < 256; ++b) {
+        out[b] = visible[b] ? cp_to_utf8(b) : cp_to_utf8(256 + fill++);
+    }
+    return out;
+}
+
+// split a UTF-8 string into codepoint-level chunks
+std::vector<std::string> utf8_chars(const std::string& s) {
+    std::vector<std::string> out;
+    size_t i = 0;
+    while (i < s.size()) {
+        unsigned char c = s[i];
+        size_t len = (c < 0x80) ? 1 : (c < 0xE0) ? 2 : (c < 0xF0) ? 3 : 4;
+        out.push_back(s.substr(i, len));
+        i += len;
+    }
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(const char* merges_path) {
+    std::ifstream in(merges_path);
+    if (!in) return nullptr;
+    auto* bpe = new BPE();
+
+    auto alphabet = byte_alphabet();
+    std::vector<std::string> vocab;
+    vocab.reserve(49408);
+    for (auto& c : alphabet) vocab.push_back(c);
+    for (auto& c : alphabet) vocab.push_back(c + "</w>");
+
+    std::string line;
+    std::getline(in, line);  // header
+    const int kMerges = 49152 - 256 - 2;  // same slice as the Python side
+    std::vector<std::pair<std::string, std::string>> merges;
+    merges.reserve(kMerges);
+    while (static_cast<int>(merges.size()) < kMerges && std::getline(in, line)) {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        size_t sp = line.find(' ');
+        if (sp == std::string::npos) continue;
+        merges.emplace_back(line.substr(0, sp), line.substr(sp + 1));
+    }
+    for (size_t i = 0; i < merges.size(); ++i) {
+        bpe->rank[merges[i]] = static_cast<int32_t>(i);
+        vocab.push_back(merges[i].first + merges[i].second);
+    }
+    vocab.push_back("<|startoftext|>");
+    vocab.push_back("<|endoftext|>");
+    for (size_t i = 0; i < vocab.size(); ++i) bpe->encoder[vocab[i]] = static_cast<int32_t>(i);
+    return bpe;
+}
+
+int bpe_encode_word(void* handle, const char* word_utf8, int32_t* out, int cap) {
+    auto* bpe = static_cast<BPE*>(handle);
+    if (!bpe || !word_utf8) return -1;
+
+    std::vector<std::string> parts = utf8_chars(word_utf8);
+    if (parts.empty()) return 0;
+    parts.back() += "</w>";
+
+    while (parts.size() > 1) {
+        int32_t best = INT32_MAX;
+        for (size_t i = 0; i + 1 < parts.size(); ++i) {
+            auto it = bpe->rank.find({parts[i], parts[i + 1]});
+            if (it != bpe->rank.end() && it->second < best) best = it->second;
+        }
+        if (best == INT32_MAX) break;
+        std::vector<std::string> merged;
+        merged.reserve(parts.size());
+        for (size_t i = 0; i < parts.size();) {
+            if (i + 1 < parts.size()) {
+                auto it = bpe->rank.find({parts[i], parts[i + 1]});
+                if (it != bpe->rank.end() && it->second == best) {
+                    merged.push_back(parts[i] + parts[i + 1]);
+                    i += 2;
+                    continue;
+                }
+            }
+            merged.push_back(parts[i]);
+            ++i;
+        }
+        parts.swap(merged);
+    }
+
+    int n = 0;
+    for (auto& sym : parts) {
+        auto it = bpe->encoder.find(sym);
+        if (it == bpe->encoder.end()) return -2;  // unknown symbol
+        if (n >= cap) return -3;
+        out[n++] = it->second;
+    }
+    return n;
+}
+
+void bpe_destroy(void* handle) { delete static_cast<BPE*>(handle); }
+
+}  // extern "C"

@@ -146,3 +146,49 @@ def test_tar_pipeline_missing_shard_warns(tar_shard, capsys):
     )
     assert len(list(stream)) == 2
     assert "skipping" in capsys.readouterr().out
+
+
+# --- native C++ BPE ----------------------------------------------------------
+
+def test_native_bpe_matches_python():
+    import subprocess
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    lib = root / "native" / "_libbpe.so"
+    if not lib.exists():
+        r = subprocess.run(["make", "-C", str(root / "native")], capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("no C++ toolchain to build native BPE")
+    from dalle_pytorch_tpu.data._native_bpe import NativeBPE
+    from dalle_pytorch_tpu.data.tokenizer import VOCAB_PATH
+
+    native = NativeBPE(VOCAB_PATH)
+    texts = [
+        "a small orange circle",
+        "the quick brown fox jumps over the lazy dog",
+        "Hello, World! 123",
+        "naïve café — résumé",
+        "supercalifragilisticexpialidocious antidisestablishmentarianism",
+    ]
+    for text in texts:
+        want = TOK.encode(text)  # pure python
+        import dalle_pytorch_tpu.data.tokenizer as tmod
+
+        cleaned = tmod._clean_text(text).lower()
+        got = []
+        for word in TOK._pattern.findall(cleaned):
+            mapped = "".join(TOK.byte_encoder[b] for b in word.encode("utf-8"))
+            got.extend(native.encode_word(mapped))
+        assert got == want, (text, got, want)
+
+
+def test_tokenizer_uses_native_when_built():
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    if not (root / "native" / "_libbpe.so").exists():
+        pytest.skip("native BPE not built")
+    t = SimpleTokenizer(use_native=True)
+    assert t._native is not None
+    assert t.encode("a small orange circle") == TOK.encode("a small orange circle")
