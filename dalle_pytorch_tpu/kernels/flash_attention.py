@@ -1,19 +1,19 @@
-"""Pallas TPU flash attention.
+"""Pallas TPU flash attention (forward + backward kernels).
 
-The memory-linear attention kernel for the `full` (and pattern-masked)
-attention paths: blockwise online-softmax accumulation in VMEM, never
-materializing the (n, n) score matrix in HBM.  This is the TPU replacement
-for the reference's DeepSpeed/Triton sparse CUDA kernels
-(/root/reference/dalle_pytorch/attention.py:339-398) and the dense einsum
-path — block sparsity shows up here as *skipped tiles*: causally-dead tiles
-are never computed, and pattern masks are applied tile-by-tile.
+The memory-linear attention path for `full` and pattern-masked attention:
+blockwise online-softmax in VMEM, never materializing (n, n) scores in HBM —
+forward saves only (out, logsumexp).  This replaces both the reference's
+dense einsum attention and its DeepSpeed/Triton block-sparse CUDA kernels
+(/root/reference/dalle_pytorch/attention.py:339-398): block sparsity appears
+as *skipped tiles* — causally-dead tiles and tiles whose static pattern-mask
+block is all-False are never computed, in forward and backward alike.
 
-Backward pass: jax.custom_vjp recomputing the softmax in XLA ops from the
-saved (q, k, v) — O(n·d) residual memory instead of O(n²) saved
-probabilities.  A fully-Pallas backward kernel is a planned optimization; the
-forward is where the HBM savings live.
+Backward runs as two Pallas kernels: a dq pass (grid over query tiles,
+accumulating over key tiles) and a dk/dv pass (grid over key tiles,
+accumulating over query tiles), both recomputing probabilities from the saved
+logsumexp.
 
-On CPU (tests) the kernel runs in interpret mode automatically.
+On CPU (tests) kernels run in interpret mode automatically.
 """
 from __future__ import annotations
 
@@ -22,12 +22,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-_LANES = 128  # TPU lane width: scratch rows are padded to this
+_LANES = 128  # TPU lane width; lse/delta rows are stored broadcast over lanes
 _NEG = -1e30
 
 
@@ -35,7 +36,33 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+def _tile_live(causal: bool, use_mask: bool, live_ref, i, j, block_q: int, block_k: int):
+    live = True
+    if causal:
+        live = j * block_k <= i * block_q + block_q - 1
+    if use_mask:
+        live = jnp.logical_and(live, live_ref[i, j] > 0)
+    return live
+
+
+def _masked_scores(q32, k32, mask_ref, i, j, *, causal, block_q, block_k, use_mask):
+    s = jax.lax.dot_general(
+        q32, k32, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+    if use_mask:
+        s = jnp.where(mask_ref[:], s, _NEG)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, live_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal, block_q, block_k, scale, use_mask):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -48,18 +75,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
-        if use_mask:
-            s = jnp.where(mask_ref[:], s, _NEG)
-
+        q32 = q_ref[0].astype(jnp.float32) * scale
+        s = _masked_scores(q32, k_ref[0].astype(jnp.float32), mask_ref, i, j,
+                           causal=causal, block_q=block_q, block_k=block_k, use_mask=use_mask)
         m_prev = m_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
@@ -72,20 +90,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # skip tiles strictly above the diagonal
-        pl.when(j * block_k <= i * block_q + block_q - 1)(_compute)
-    else:
-        _compute()
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+        if (causal or use_mask) else _compute()
 
     @pl.when(j == nk - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l), lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k):
-    """q, k, v: (bh, n, d); mask: (n, n) bool or None.  Returns out (bh, n, d)."""
+def _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k):
+    specs = []
+    if use_mask:
+        if live is None:
+            live = jnp.ones((nq, nk), jnp.int32)
+        specs.append(pl.BlockSpec((block_q, block_k), lambda b, i, j: (i, j)))
+        specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        return specs, (mask, live)
+    specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    return specs, (jnp.zeros((1,), jnp.int32), jnp.zeros((1, 1), jnp.int32))
+
+
+def _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k):
+    """q, k, v: (bh, n, d).  Returns (out (bh, n, d), lse (bh, n, LANES))."""
     bh, n, d = q.shape
     assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
     nq, nk = n // block_q, n // block_k
@@ -96,77 +125,210 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k):
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
     ]
-    if use_mask:
-        in_specs.append(pl.BlockSpec((block_q, block_k), lambda b, i, j: (i, j)))
-        args = (q, k, v, mask)
-    else:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # dummy scalar
-        args = (q, k, v, jnp.zeros((1,), jnp.int32))
+    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k)
+    in_specs += mspecs
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
         scale=scale, use_mask=use_mask,
     )
     flops = 2 * 2 * bh * n * n * d * (0.5 if causal else 1.0)
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n, _LANES), jnp.float32),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=int(flops), bytes_accessed=int(3 * bh * n * d * 4), transcendentals=int(bh * n * n),
+            flops=int(flops), bytes_accessed=int(3 * bh * n * d * 4),
+            transcendentals=int(bh * n * n),
         ),
         interpret=_interpret(),
-    )(*args)
+    )(q, k, v, *margs)
+    return out, lse
 
 
-def _dense_recompute_grads(q, k, v, mask, causal, scale, do):
-    """Backward via full softmax recomputation (O(n²) transient, fused by XLA)."""
-    f32 = jnp.float32
-    s = jnp.einsum("bid,bjd->bij", q.astype(f32) * scale, k.astype(f32))
-    n = q.shape[1]
-    if causal:
-        i_pos = jnp.arange(n)[:, None]
-        j_pos = jnp.arange(n)[None, :]
-        s = jnp.where(j_pos <= i_pos, s, _NEG)
-    if mask is not None:
-        s = jnp.where(mask[None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    do32 = do.astype(f32)
-    dv = jnp.einsum("bij,bid->bjd", p, do32)
-    dp = jnp.einsum("bid,bjd->bij", do32, v.astype(f32))
-    out = jnp.einsum("bij,bjd->bid", p, v.astype(f32))
-    delta = jnp.sum(do32 * out, axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bij,bjd->bid", ds, k.astype(f32)) * scale
-    dk = jnp.einsum("bij,bid->bjd", ds, q.astype(f32)) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_ref,
+               dq_ref, dq_scr, *, causal, block_q, block_k, scale, use_mask):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q32 = q_ref[0].astype(jnp.float32) * scale
+        s = _masked_scores(q32, k_ref[0].astype(jnp.float32), mask_ref, i, j,
+                           causal=causal, block_q=block_q, block_k=block_k, use_mask=use_mask)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+        if (causal or use_mask) else _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, block_q, block_k, scale, use_mask):
+    # grid: (bh, key tile j, query tile i) — accumulate over query tiles
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q32 = q_ref[0].astype(jnp.float32) * scale
+        s = _masked_scores(q32, k_ref[0].astype(jnp.float32), mask_ref, i, j,
+                           causal=causal, block_q=block_q, block_k=block_k, use_mask=use_mask)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # (bq, bk)
+        do32 = do_ref[0].astype(jnp.float32)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do32, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q32, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+        if (causal or use_mask) else _compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_vjp_fwd(q, k, v, mask, causal, scale, block_q, block_k):
-    out = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k)
-    return out, (q, k, v, mask)
+def _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_k):
+    bh, n, d = q.shape
+    nq, nk = n // block_q, n // block_k
+    use_mask = mask is not None
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, n, _LANES))
+
+    qkvdo_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),  # delta
+    ]
+    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
+                          scale=scale, use_mask=use_mask),
+        grid=(bh, nq, nk),
+        in_specs=qkvdo_specs + mspecs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta, *margs)
+
+    # dk/dv pass: grid over key tiles; index maps swap i/j roles
+    kv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),  # delta
+    ]
+    if use_mask:
+        mspecs2 = [
+            pl.BlockSpec((block_q, block_k), lambda b, j, i: (i, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+    else:
+        mspecs2 = mspecs
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
+                          scale=scale, use_mask=use_mask),
+        grid=(bh, nk, nq),
+        in_specs=kv_specs + mspecs2,
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta, *margs)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, mask, live, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, mask, live, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k)
+    return out, (q, k, v, mask, live, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, mask = res
-    dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, do)
-    return dq, dk, dv, None
+    q, k, v, mask, live, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_k)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
 def flash_attention(
     q: jnp.ndarray,
@@ -179,16 +341,30 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jnp.ndarray:
     """(b, h, n, d) attention.  `mask`: optional static (n, n) bool pattern
-    (True = may attend) — combined with causality inside the kernel.  q is
-    expected UNSCALED (scale defaults to d^-1/2), unlike ops.attention.attend."""
+    (True = may attend), combined with causality inside the kernel; a
+    tile-liveness table is derived from it at trace time so fully-masked
+    tiles cost nothing.  q is expected UNSCALED (scale defaults to d^-1/2),
+    unlike ops.attention.attend."""
     b, h, n, d = q.shape
     if scale is None:
         scale = d ** -0.5
     block_q = min(block_q, n)
     block_k = min(block_k, n)
 
+    live = None
+    if mask is not None:
+        try:  # static masks (the normal case) yield a tile-liveness table
+            mask_np = np.asarray(mask)
+            live = jnp.asarray(
+                mask_np.reshape(n // block_q, block_q, n // block_k, block_k)
+                .any(axis=(1, 3))
+                .astype(np.int32)
+            )
+        except Exception:
+            live = None  # traced mask: no tile skipping
+
     qf = q.reshape(b * h, n, d)
     kf = k.reshape(b * h, n, d)
     vf = v.reshape(b * h, n, d)
-    out = _flash(qf, kf, vf, mask, causal, scale, block_q, block_k)
+    out = _flash(qf, kf, vf, mask, live, causal, scale, block_q, block_k)
     return out.reshape(b, h, n, d)

@@ -13,11 +13,9 @@ For very large multi-host runs, orbax can replace the npz container behind
 the same API (save/load names + meta)."""
 from __future__ import annotations
 
-import io
 import json
 import os
 import pickle
-import re
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 

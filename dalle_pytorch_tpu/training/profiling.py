@@ -1,0 +1,104 @@
+"""Profiling & MFU accounting.
+
+The reference exposes only DeepSpeed's FLOPS profiler and a hand-rolled
+sample_per_sec counter (SURVEY.md §5).  TPU-native equivalents:
+
+* analytic per-step FLOPs for a DALLE config (dalle_step_flops) and the MFU
+  derived from wall-clock — the number the BASELINE targets are written in;
+* jax.profiler trace capture (TensorBoard-compatible) around a step window;
+* a StepTimer that measures correctly under async dispatch
+  (block_until_ready on the full carried state, discarding the first
+  overlapped measurement).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+PEAK_BF16_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def chip_peak_flops(default: float = 197e12) -> float:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key.replace(" ", "") in kind.replace(" ", ""):
+            return val
+    return default
+
+
+def matmul_param_count(params: Any) -> int:
+    return int(sum(x.size for x in jax.tree_util.tree_leaves(params) if getattr(x, "ndim", 0) == 2))
+
+
+def dalle_step_flops(cfg, batch: int, n_matmul_params: int, with_backward: bool = True) -> float:
+    """Analytic FLOPs for one (micro)step: 2*P*T matmul cost + causal
+    attention scores/values; backward ≈ 2x forward."""
+    s = cfg.total_seq_len
+    proj = 2.0 * n_matmul_params * batch * s
+    attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * 0.5 * cfg.depth
+    fwd = proj + attn
+    return (3.0 if with_backward else 1.0) * fwd
+
+
+def mfu(step_flops: float, step_time_s: float, n_chips: int = 1) -> float:
+    return step_flops / step_time_s / (chip_peak_flops() * n_chips)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "./profile_trace") -> Iterator[None]:
+    """Capture a TensorBoard trace of the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Times jitted steps under async dispatch: call observe(state) each step;
+    per-step time = median of inter-block intervals after the first."""
+
+    def __init__(self):
+        self._times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def observe(self, blockable: Any):
+        jax.block_until_ready(blockable)
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._times.append(now - self._t0)
+        self._t0 = now
+
+    @property
+    def times(self):
+        return list(self._times)
+
+    def best(self) -> Optional[float]:
+        return min(self._times) if self._times else None
+
+    def summary(self) -> Dict[str, float]:
+        ts = sorted(self._times)
+        if not ts:
+            return {}
+        return {
+            "best_s": ts[0],
+            "median_s": ts[len(ts) // 2],
+            "mean_s": sum(ts) / len(ts),
+            "steps": float(len(ts)),
+        }
