@@ -306,20 +306,49 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, mask, live, causal, scale, block_q, block_k):
+def _dense_recompute_grads(q, k, v, mask, causal, scale, lse, do):
+    """Backward in XLA ops with exact probabilities from the saved logsumexp.
+    Materializes (bh, n, n) transients (fused/streamed by XLA) — measured
+    faster than the two-pass Pallas backward at seq ~1280 on v5e; the Pallas
+    backward wins on memory for long sequences."""
+    f32 = jnp.float32
+    s = jnp.einsum("bid,bjd->bij", q.astype(f32) * scale, k.astype(f32))
+    n = q.shape[1]
+    if causal:
+        i_pos = jnp.arange(n)[:, None]
+        j_pos = jnp.arange(n)[None, :]
+        s = jnp.where(j_pos <= i_pos, s, _NEG)
+    if mask is not None:
+        s = jnp.where(mask[None], s, _NEG)
+    p = jnp.exp(s - lse[:, :, :1])
+    do32 = do.astype(f32)
+    dv = jnp.einsum("bij,bid->bjd", p, do32)
+    dp = jnp.einsum("bid,bjd->bij", do32, v.astype(f32))
+    out = jnp.einsum("bij,bjd->bid", p, v.astype(f32))
+    delta = jnp.sum(do32 * out, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bij,bjd->bid", ds, k.astype(f32)) * scale
+    dk = jnp.einsum("bij,bid->bjd", ds, q.astype(f32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, mask, live, causal, scale, block_q, block_k, bwd_impl):
     out, _ = _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, mask, live, causal, scale, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, mask, live, causal, scale, block_q, block_k, bwd_impl):
     out, lse = _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k)
     return out, (q, k, v, mask, live, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, bwd_impl, res, do):
     q, k, v, mask, live, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_k)
+    if bwd_impl == "pallas":
+        dq, dk, dv = _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_k)
+    else:
+        dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, lse, do)
     return dq, dk, dv, None, None
 
 
@@ -339,6 +368,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    bwd_impl: str = "xla",  # 'xla' (fastest at seq ~1e3) | 'pallas' (O(n) memory)
 ) -> jnp.ndarray:
     """(b, h, n, d) attention.  `mask`: optional static (n, n) bool pattern
     (True = may attend), combined with causality inside the kernel; a
@@ -366,5 +396,5 @@ def flash_attention(
     qf = q.reshape(b * h, n, d)
     kf = k.reshape(b * h, n, d)
     vf = v.reshape(b * h, n, d)
-    out = _flash(qf, kf, vf, mask, live, causal, scale, block_q, block_k)
+    out = _flash(qf, kf, vf, mask, live, causal, scale, block_q, block_k, bwd_impl)
     return out.reshape(b, h, n, d)
