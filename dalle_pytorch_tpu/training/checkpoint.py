@@ -72,3 +72,30 @@ def rotate_checkpoints(directory: str, pattern: str, keep_n: Optional[int]) -> N
 def to_host(tree: Any) -> Any:
     """Fully materialize a (possibly sharded) pytree on host."""
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+# --- orbax-backed sharded checkpoints (multi-host scale) --------------------
+
+def save_sharded(directory: str, state: Any, meta: Optional[Dict[str, Any]] = None) -> None:
+    """Distributed checkpoint: each host writes its shards (no gather).  Use
+    for large multi-host runs; `save_checkpoint` is the single-file path."""
+    import orbax.checkpoint as ocp
+
+    path = Path(directory).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "state", state, force=True)
+    if meta is not None and jax.process_index() == 0:
+        (path / "meta.json").write_text(json.dumps(meta))
+
+
+def load_sharded(directory: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Restore into `template`'s structure/shardings (abstract arrays with
+    shardings re-shard onto the current mesh)."""
+    import orbax.checkpoint as ocp
+
+    path = Path(directory).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path / "state", template)
+    meta_file = path / "meta.json"
+    meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+    return state, meta
