@@ -7,6 +7,7 @@ distribution via mesh sharding instead of NCCL all-reduce.
 Public surface (mirroring the reference's `from dalle_pytorch import ...`):
 configs + init/apply functions for DALLE, CLIP and DiscreteVAE, the sampling
 entry points, and the parallel/data/training subsystems as submodules."""
+from dalle_pytorch_tpu.api import CLIP, DALLE, DiscreteVAE, OpenAIDiscreteVAE, VQGanVAE
 from dalle_pytorch_tpu.models.clip import CLIPConfig, forward as clip_forward, init_clip
 from dalle_pytorch_tpu.models.dalle import DALLEConfig, forward as dalle_forward, init_dalle
 from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts, sample_image_codes
@@ -20,6 +21,11 @@ from dalle_pytorch_tpu.models.vae import (
 from dalle_pytorch_tpu.version import __version__
 
 __all__ = [
+    "CLIP",
+    "DALLE",
+    "DiscreteVAE",
+    "OpenAIDiscreteVAE",
+    "VQGanVAE",
     "CLIPConfig",
     "DALLEConfig",
     "DiscreteVAEConfig",

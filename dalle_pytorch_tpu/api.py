@@ -1,0 +1,199 @@
+"""Object-style API facade.
+
+The functional core (configs + init/apply functions) is the real interface,
+but users coming from the reference expect `DiscreteVAE(...)`, `DALLE(dim=...,
+vae=vae, ...)`, `CLIP(...)` objects with methods (README usage,
+/root/reference/README.md:77-304).  These thin wrappers bundle (config,
+params, PRNG key) and delegate to the functional modules — no hidden state
+beyond the parameter pytree they carry.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.models import clip as _clip
+from dalle_pytorch_tpu.models import dalle as _dalle
+from dalle_pytorch_tpu.models import sampling as _sampling
+from dalle_pytorch_tpu.models import vae as _vae
+
+
+def _as_key(key_or_seed):
+    if isinstance(key_or_seed, int):
+        return jax.random.PRNGKey(key_or_seed)
+    return key_or_seed
+
+
+class DiscreteVAE:
+    def __init__(self, key=0, params: Optional[dict] = None, **cfg_kwargs):
+        self.cfg = _vae.DiscreteVAEConfig(**cfg_kwargs)
+        self.params = params if params is not None else _vae.init_discrete_vae(_as_key(key), self.cfg)
+
+    # reference attribute surface
+    @property
+    def image_size(self):
+        return self.cfg.image_size
+
+    @property
+    def num_tokens(self):
+        return self.cfg.num_tokens
+
+    @property
+    def num_layers(self):
+        return self.cfg.num_layers
+
+    @property
+    def channels(self):
+        return self.cfg.channels
+
+    def __call__(self, images, key=None, return_loss=False, return_recons=False, temp=None):
+        return _vae.forward(
+            self.params, self.cfg, images, key=_as_key(key if key is not None else 0),
+            return_loss=return_loss, return_recons=return_recons, temp=temp,
+        )
+
+    forward = __call__
+
+    def get_codebook_indices(self, images):
+        return _vae.get_codebook_indices(self.params, self.cfg, images)
+
+    def decode(self, img_seq):
+        return _vae.decode_indices(self.params, self.cfg, img_seq)
+
+
+class DALLE:
+    def __init__(self, *, vae: DiscreteVAE, key=1, params: Optional[dict] = None, **cfg_kwargs):
+        self.vae = vae
+        self.cfg = _dalle.DALLEConfig.from_vae(vae.cfg, **cfg_kwargs)
+        self.params = params if params is not None else _dalle.init_dalle(_as_key(key), self.cfg)
+
+    @property
+    def text_seq_len(self):
+        return self.cfg.text_seq_len
+
+    @property
+    def image_seq_len(self):
+        return self.cfg.image_seq_len
+
+    @property
+    def total_seq_len(self):
+        return self.cfg.total_seq_len
+
+    def __call__(self, text, image=None, return_loss=False, null_cond_prob=0.0, key=None):
+        """image: raw pixels (B, H, W, C) or code ids (B, image_seq_len)."""
+        codes = image
+        if image is not None and image.ndim == 4:
+            codes = jax.lax.stop_gradient(self.vae.get_codebook_indices(image))
+        return _dalle.forward(
+            self.params, self.cfg, text, codes, return_loss=return_loss,
+            null_cond_prob=null_cond_prob, key=key,
+        )
+
+    forward = __call__
+
+    def generate_images(self, text, key=0, clip=None, filter_thres=0.5, temperature=1.0,
+                        img=None, num_init_img_tokens=None, cond_scale=1.0):
+        return _sampling.generate_images(
+            self.params, self.cfg, self.vae.params, self.vae.cfg, text, _as_key(key),
+            filter_thres=filter_thres, temperature=temperature, img=img,
+            num_init_img_tokens=num_init_img_tokens, cond_scale=cond_scale,
+            clip_params=clip.params if clip is not None else None,
+            clip_cfg=clip.cfg if clip is not None else None,
+        )
+
+    def generate_texts(self, tokenizer=None, text=None, key=0, filter_thres=0.5, temperature=1.0):
+        prompt = None
+        if isinstance(text, str):
+            assert tokenizer is not None
+            ids = tokenizer.encode(text)
+            prompt = jnp.asarray([ids], jnp.int32)
+        elif text is not None:
+            prompt = text
+        tokens = _sampling.generate_texts(
+            self.params, self.cfg, _as_key(key), text=prompt,
+            filter_thres=filter_thres, temperature=temperature,
+        )
+        texts = None
+        if tokenizer is not None:
+            pad_tokens = set(
+                range(self.cfg.num_text_tokens_padded - self.cfg.text_seq_len,
+                      self.cfg.num_text_tokens_padded)
+            )
+            import numpy as np
+
+            texts = [tokenizer.decode(np.asarray(t), pad_tokens=pad_tokens) for t in tokens]
+        return tokens, texts
+
+
+class CLIP:
+    def __init__(self, key=2, params: Optional[dict] = None, **cfg_kwargs):
+        self.cfg = _clip.CLIPConfig(**cfg_kwargs)
+        self.params = params if params is not None else _clip.init_clip(_as_key(key), self.cfg)
+
+    def __call__(self, text, images, text_mask=None, return_loss=False):
+        return _clip.forward(self.params, self.cfg, text, images, text_mask=text_mask,
+                             return_loss=return_loss)
+
+    forward = __call__
+
+
+class OpenAIDiscreteVAE:
+    """Pretrained OpenAI dVAE (weights converted from the published pickles
+    via models/openai_vae.load_openai_vae)."""
+
+    def __init__(self, encoder_path: str, decoder_path: str):
+        from dalle_pytorch_tpu.models import openai_vae as _ovae
+
+        self.cfg = _ovae.OpenAIVAEConfig()
+        self.params = _ovae.load_openai_vae(encoder_path, decoder_path)
+        self._mod = _ovae
+
+    image_size = 256
+    num_layers = 3
+    num_tokens = 8192
+    channels = 3
+
+    def get_codebook_indices(self, images):
+        return self._mod.get_codebook_indices(self.params, self.cfg, images)
+
+    def decode(self, img_seq):
+        return self._mod.decode_indices(self.params, self.cfg, img_seq)
+
+
+class VQGanVAE:
+    """Pretrained taming VQGAN/GumbelVQ (weights converted from a checkpoint
+    via models/vqgan.load_vqgan)."""
+
+    def __init__(self, vqgan_model_path: str, vqgan_config: Optional[dict] = None):
+        from dalle_pytorch_tpu.models import vqgan as _vqgan
+
+        self.params, self.cfg = _vqgan.load_vqgan(vqgan_model_path, vqgan_config)
+        self._mod = _vqgan
+
+    @property
+    def image_size(self):
+        return self.cfg.image_size
+
+    @property
+    def num_layers(self):
+        return self.cfg.num_layers
+
+    @property
+    def num_tokens(self):
+        return self.cfg.num_tokens
+
+    @property
+    def channels(self):
+        return self.cfg.channels
+
+    @property
+    def is_gumbel(self):
+        return self.cfg.is_gumbel
+
+    def get_codebook_indices(self, images):
+        return self._mod.get_codebook_indices(self.params, self.cfg, images)
+
+    def decode(self, img_seq):
+        return self._mod.decode_indices(self.params, self.cfg, img_seq)

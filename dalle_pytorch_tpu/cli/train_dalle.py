@@ -218,8 +218,16 @@ def main(argv=None):
             return_loss=True, key=key,
         )
 
-    lr = optax.exponential_decay(args.learning_rate, 10000, 0.98) if args.lr_decay else args.learning_rate
-    optimizer = optax.adam(lr)
+    optimizer = optax.adam(args.learning_rate)
+    if args.lr_decay:
+        # ReduceLROnPlateau parity (reference train_dalle.py:451-459:
+        # factor 0.5, patience 10, cooldown 10, min_lr 1e-6)
+        optimizer = optax.chain(
+            optimizer,
+            optax.contrib.reduce_on_plateau(
+                factor=0.5, patience=10, cooldown=10, min_scale=1e-6 / args.learning_rate
+            ),
+        )
     settings = StepSettings(
         grad_accum=args.ga_steps,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,

@@ -106,13 +106,18 @@ def make_train_step(
         scale = 1.0 / accum
         return jax.tree_util.tree_map(lambda x: x * scale, g), l * scale
 
+    # allow schedules that consume the loss (e.g. reduce_on_plateau)
+    optimizer = optax.with_extra_args_support(optimizer)
+
     def step_fn_inner(state: TrainState, batch, key):
         grads, loss = grads_and_loss(state.params, batch, key)
         if settings.clip_grad_norm is not None:
             gnorm = optax.global_norm(grads)
             factor = jnp.minimum(1.0, settings.clip_grad_norm / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params, value=loss
+        )
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(state.step + 1, params, opt_state)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}

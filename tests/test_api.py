@@ -1,0 +1,55 @@
+"""The object-style facade: reference README usage shapes
+(/root/reference/README.md:77-304) on tiny configs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu import CLIP, DALLE, DiscreteVAE
+
+
+def test_reference_readme_usage_vae():
+    vae = DiscreteVAE(
+        image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=16,
+        temperature=0.9, straight_through=False,
+    )
+    images = jax.random.uniform(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    loss = vae(images, key=jax.random.PRNGKey(1), return_loss=True)
+    assert np.isfinite(float(loss))
+    assert vae.image_size == 16 and vae.num_tokens == 32
+
+
+def test_reference_readme_usage_dalle():
+    vae = DiscreteVAE(image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=16)
+    dalle = DALLE(
+        dim=32, vae=vae, num_text_tokens=64, text_seq_len=8, depth=1, heads=2,
+        dim_head=8, attn_dropout=0.0, ff_dropout=0.0,
+    )
+    text = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 64)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (2, 16, 16, 3))
+
+    loss = dalle(text, images, return_loss=True)  # raw pixels in, like the reference
+    assert np.isfinite(float(loss))
+
+    out = dalle.generate_images(text, key=3)
+    assert out.shape == (2, 16, 16, 3)
+
+    toks, texts = dalle.generate_texts(text=jnp.asarray([[3]], jnp.int32), key=4)
+    assert toks.shape == (1, 8) and texts is None
+
+
+def test_reference_readme_usage_clip():
+    clip = CLIP(
+        dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=64,
+        text_enc_depth=1, text_seq_len=8, text_heads=2, visual_enc_depth=1,
+        visual_heads=2, visual_image_size=16, visual_patch_size=8,
+    )
+    text = jax.random.randint(jax.random.PRNGKey(0), (4, 8), 0, 64)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (4, 16, 16, 3))
+    mask = jnp.ones((4, 8), bool)
+    loss = clip(text, images, text_mask=mask, return_loss=True)
+    assert np.isfinite(float(loss))
+
+    dalle_vae = DiscreteVAE(image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=16)
+    dalle = DALLE(dim=32, vae=dalle_vae, num_text_tokens=64, text_seq_len=8, depth=1, heads=2, dim_head=8)
+    images_ranked, scores = dalle.generate_images(text[:2], key=5, clip=clip)
+    assert images_ranked.shape == (2, 16, 16, 3) and scores.shape == (2,)
