@@ -78,6 +78,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--lr_decay", action="store_true")
     parser.add_argument("--sample_every_n_steps", type=int, default=100)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--debug_nans", action="store_true",
+                        help="abort with a traceback on the first NaN (jax_debug_nans)")
     # mesh / ZeRO
     parser.add_argument("--zero_stage", type=int, default=0, choices=[0, 1, 2, 3])
     parser.add_argument("--mesh_dp", type=int, default=-1)
@@ -142,6 +144,8 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
 
     be = backend_mod.set_backend_from_args(args)
     be.initialize()

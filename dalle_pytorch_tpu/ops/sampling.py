@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 
 def log_clamp(t: jnp.ndarray, eps: float = 1e-20) -> jnp.ndarray:
-    return jnp.log(jnp.clip(t, a_min=eps))
+    return jnp.log(jnp.clip(t, min=eps))
 
 
 def gumbel_noise(key: jax.Array, shape, dtype=jnp.float32) -> jnp.ndarray:
