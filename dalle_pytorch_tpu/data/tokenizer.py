@@ -46,18 +46,17 @@ _WORD_PATTERN = (
 @lru_cache()
 def _byte_to_unicode() -> dict:
     """Invertible byte -> printable-unicode-char table (the standard GPT-2
-    byte-level BPE alphabet)."""
+    byte-level BPE alphabet).  Insertion order matters: the vocab lists the
+    printable bytes first, then the remapped ones — token ids depend on it."""
     visible = (
         list(range(ord("!"), ord("~") + 1))
         + list(range(ord("¡"), ord("¬") + 1))
         + list(range(ord("®"), ord("ÿ") + 1))
     )
-    mapping = {}
+    mapping = {b: chr(b) for b in visible}
     fill = 0
     for b in range(256):
-        if b in visible:
-            mapping[b] = chr(b)
-        else:
+        if b not in mapping:
             mapping[b] = chr(256 + fill)
             fill += 1
     return mapping

@@ -54,17 +54,21 @@ std::string cp_to_utf8(uint32_t cp) {
 }
 
 // the GPT-2/CLIP byte -> printable-unicode alphabet (matches
-// dalle_pytorch_tpu/data/tokenizer.py::_byte_to_unicode)
+// dalle_pytorch_tpu/data/tokenizer.py::_byte_to_unicode).  The returned
+// vector is in VOCAB order (printable bytes first, then remapped extras);
+// token ids depend on this ordering.
 std::vector<std::string> byte_alphabet() {
     std::vector<bool> visible(256, false);
     for (int b = '!'; b <= '~'; ++b) visible[b] = true;
     for (int b = 0xA1; b <= 0xAC; ++b) visible[b] = true;
     for (int b = 0xAE; b <= 0xFF; ++b) visible[b] = true;
-    std::vector<std::string> out(256);
+    std::vector<std::string> out;
+    out.reserve(256);
+    for (int b = 0; b < 256; ++b)
+        if (visible[b]) out.push_back(cp_to_utf8(b));
     int fill = 0;
-    for (int b = 0; b < 256; ++b) {
-        out[b] = visible[b] ? cp_to_utf8(b) : cp_to_utf8(256 + fill++);
-    }
+    for (int b = 0; b < 256; ++b)
+        if (!visible[b]) out.push_back(cp_to_utf8(256 + fill++));
     return out;
 }
 
