@@ -41,27 +41,7 @@ def _chip_peak() -> float:
     return PEAK_BF16_FLOPS.get(gen, 197e12)
 
 
-def _matmul_params(params) -> int:
-    import numpy as np
-
-    return int(
-        sum(
-            x.size
-            for path, x in jax.tree_util.tree_leaves_with_path(params)
-            if getattr(x, "ndim", 0) == 2
-        )
-    )
-
-
-def dalle_step_flops(cfg, batch: int, n_matmul_params: int) -> float:
-    """Analytic FLOPs for one train step (fwd + bwd = 3x fwd matmul cost)."""
-    s = cfg.total_seq_len
-    # projections/ff/logits: 2 * P * tokens per fwd pass
-    proj = 2.0 * n_matmul_params * batch * s
-    # attention scores+values: 2 ops * 2 matmuls * B*H*S^2*dh, causal halves it
-    attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * 0.5
-    attn *= cfg.depth
-    return 3.0 * (proj + attn)
+from dalle_pytorch_tpu.training.profiling import dalle_step_flops, matmul_param_count
 
 
 def main():
@@ -81,6 +61,7 @@ def main():
             num_image_tokens=8192, image_fmap_size=32,
             attn_types=("full", "axial_row", "axial_col", "conv_like"),
             shift_tokens=True, rotary_emb=True, execution="sequential",
+            share_input_output_emb=True,
         )
         batch = 8
         steps, warmup = 10, 2
@@ -108,7 +89,7 @@ def main():
         "image_codes": jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.image_seq_len), 0, cfg.num_image_tokens),
     }
 
-    n_matmul = _matmul_params(state.params)
+    n_matmul = matmul_param_count(state.params)
 
     # NB: timing must end with an actual device->host value fetch —
     # block_until_ready alone can return before remote execution finishes on

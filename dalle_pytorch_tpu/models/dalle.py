@@ -55,6 +55,7 @@ class DALLEConfig:
     conv_dilation: int = 1
     sparse_block_size: int = 16
     attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
+    seq_shard_axis: Optional[str] = None  # sequence-parallel mesh axis (e.g. 'sp')
 
     # -- derived ----------------------------------------------------------
     @property
@@ -102,6 +103,7 @@ class DALLEConfig:
             conv_dilation=self.conv_dilation,
             sparse_block_size=self.sparse_block_size,
             attn_kernel=self.attn_kernel,
+            seq_shard_axis=self.seq_shard_axis,
         )
 
     def to_dict(self) -> dict:

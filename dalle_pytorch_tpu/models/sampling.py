@@ -61,10 +61,14 @@ def sample_image_codes(
     cond_scale: float = 1.0,
     primer_codes: Optional[jnp.ndarray] = None,
     prime_len: int = 0,
+    noise_override: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """text: (b, text_seq_len) raw token ids (0 = pad).  primer_codes:
-    optional (b, prime_len) VAE codes to prime the image with.  Returns
-    (b, image_seq_len) image codes (primer included)."""
+    optional (b, prime_len) VAE codes to prime the image with.
+    noise_override: optional (n_gen, b, total_tokens) pre-generated gumbel
+    noise consumed instead of key-derived noise — the parity-RNG mode for
+    bit-exact comparison against other implementations (SURVEY.md §7 hard
+    part #1).  Returns (b, image_seq_len) image codes (primer included)."""
     b = text.shape[0]
     tcfg = cfg.transformer_config()
     guided = cond_scale != 1.0
@@ -92,34 +96,43 @@ def sample_image_codes(
     n_gen = cfg.image_seq_len - prime_len
     assert n_gen > 0, "primer must be shorter than the image sequence"
 
-    def sample_token(logits, k):
+    def sample_token(logits, k, noise):
         if guided:
             logits = _cfg_combine(logits, cond_scale)
         filtered = top_k_filter(logits, thres=filter_thres)
-        tok = gumbel_sample(k, filtered, temperature=temperature)
+        if noise is not None:
+            tok = jnp.argmax(filtered / temperature + noise, axis=-1)
+        else:
+            tok = gumbel_sample(k, filtered, temperature=temperature)
         code = jnp.clip(tok - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1)
         return code
 
     key, k0 = jax.random.split(key)
-    first_code = sample_token(last_logits, k0)
+    first_code = sample_token(
+        last_logits, k0, noise_override[0] if noise_override is not None else None
+    )
 
     step_keys = jax.random.split(key, max(n_gen - 1, 1))
 
     # NB: positions — the transformer output at sequence position p produces
     # the logits for sequence position p+1; the logits-mask row is p (the
     # reference masks rows by the producing position).
-    def body(carry, step_key):
+    def body(carry, xs):
+        step_key, noise = xs if noise_override is not None else (xs, None)
         cache, prev_code, img_pos = carry
         feed = jnp.tile(prev_code, (2,)) if guided else prev_code
         x = dalle_mod.embed_image_codes(params, cfg, feed[:, None], start=img_pos)
         out, cache = decode_step(params["transformer"], tcfg, x, cache)
         logits = _logits_at(params, cfg, out, cache["offset"] - 1)
-        code = sample_token(logits, step_key)
+        code = sample_token(logits, step_key, noise)
         return (cache, code, img_pos + 1), code
 
     init = (cache, first_code, jnp.asarray(prime_len, jnp.int32))
     if n_gen > 1:
-        (_, _, _), rest = jax.lax.scan(body, init, step_keys[: n_gen - 1])
+        xs = step_keys[: n_gen - 1]
+        if noise_override is not None:
+            xs = (xs, noise_override[1:n_gen])
+        (_, _, _), rest = jax.lax.scan(body, init, xs)
         codes = jnp.concatenate([first_code[None], rest], axis=0).T  # (b, n_gen)
     else:
         codes = first_code[:, None]

@@ -63,6 +63,11 @@ class TransformerConfig:
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     execution: str = "sequential"  # 'sequential' | 'remat' | 'reversible'
     attn_kernel: str = "auto"  # 'auto' | 'flash' (Pallas) | 'xla' (dense masked)
+    # sequence parallelism: shard activations' sequence dim over this mesh
+    # axis between layers (GSPMD inserts the attention collectives); the
+    # explicit ring-attention kernel (parallel/ring.py) is the hand-tuned
+    # alternative for very long sequences
+    seq_shard_axis: Optional[str] = None
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
@@ -195,6 +200,8 @@ def _merge_heads(x):
 def _use_flash(cfg, n: int, key_mask) -> bool:
     if cfg.attn_kernel == "xla" or key_mask is not None:
         return False
+    if cfg.seq_shard_axis is not None:
+        return False  # GSPMD partitions the XLA attention; pallas_call can't split seq
     if n % 128 != 0:
         return False
     if cfg.attn_kernel == "flash":
@@ -289,6 +296,15 @@ def apply_transformer(
     else:
         layer_keys = None
 
+    def seq_constraint(x):
+        if cfg.seq_shard_axis is None:
+            return x
+        from jax.sharding import PartitionSpec
+
+        return jax.lax.with_sharding_constraint(
+            x, PartitionSpec(None, cfg.seq_shard_axis, None)
+        )
+
     def branch(spec, x, kind, dkey):
         return _branch(params, cfg, spec, x, kind, rotary, patterns[spec.attn_type], key_mask, dkey)
 
@@ -316,14 +332,16 @@ def apply_transformer(
         )
         return runner(params, x, keys)
 
+    x = seq_constraint(x)
     for spec in specs:
         akey = layer_keys[spec.index, 0] if has_dropout else None
         fkey = layer_keys[spec.index, 1] if has_dropout else None
 
         def block(x, akey=akey, fkey=fkey, spec=spec):
             x = x + branch(spec, x, "attn", akey)
+            x = seq_constraint(x)
             x = x + branch(spec, x, "ff", fkey)
-            return x
+            return seq_constraint(x)
 
         if cfg.execution == "remat":
             x = jax.checkpoint(block)(x)

@@ -134,4 +134,12 @@ def make_train_step(
         )
         return step_fn_inner(state, batch, key)
 
-    return init_fn, jax.jit(step_fn, donate_argnums=0)
+    jitted = jax.jit(step_fn, donate_argnums=0)
+
+    def with_mesh_ctx(state, batch, key):
+        # mesh in context during trace + dispatch so models can use raw
+        # PartitionSpec constraints (e.g. the transformer's seq_shard_axis)
+        with mesh:
+            return jitted(state, batch, key)
+
+    return init_fn, with_mesh_ctx

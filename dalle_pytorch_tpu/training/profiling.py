@@ -39,8 +39,21 @@ def chip_peak_flops(default: float = 197e12) -> float:
     return default
 
 
+_LOOKUP_TABLES = ("text_emb", "image_emb", "text_pos", "image_pos", "codebook", "visual_pos")
+
+
 def matmul_param_count(params: Any) -> int:
-    return int(sum(x.size for x in jax.tree_util.tree_leaves(params) if getattr(x, "ndim", 0) == 2))
+    """Parameters that participate in matmuls (embedding *lookup* tables are
+    excluded — counting them would inflate the FLOPs estimate and the MFU)."""
+    total = 0
+    for path, x in jax.tree_util.tree_leaves_with_path(params):
+        if getattr(x, "ndim", 0) != 2:
+            continue
+        p = "/".join(str(getattr(k, "key", k)) for k in path)
+        if any(t in p for t in _LOOKUP_TABLES):
+            continue
+        total += x.size
+    return int(total)
 
 
 def dalle_step_flops(cfg, batch: int, n_matmul_params: int, with_backward: bool = True) -> float:
