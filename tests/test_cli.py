@@ -138,3 +138,19 @@ def test_generate_cli_gentxt(workspace, trained_dalle):
         "--outputs_dir", str(workspace / "outputs_gentxt"),
     ])
     assert len(paths) == 1
+
+
+def test_train_clip_cli(workspace):
+    from dalle_pytorch_tpu.cli import train_clip as train_clip_cli
+
+    state, cfg = train_clip_cli.main([
+        "--image_text_folder", str(workspace / "data"),
+        "--dim_text", "32", "--dim_image", "32", "--dim_latent", "16",
+        "--text_enc_depth", "1", "--text_seq_len", "16", "--text_heads", "2",
+        "--visual_enc_depth", "1", "--visual_heads", "2",
+        "--visual_image_size", "16", "--visual_patch_size", "8",
+        "--epochs", "1", "--batch_size", "8",
+        "--clip_output_file_name", str(workspace / "clip"),
+        "--truncate_captions", "--save_every_n_steps", "0",
+    ])
+    assert (workspace / "clip.pt").exists()

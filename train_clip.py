@@ -1,0 +1,6 @@
+#!/usr/bin/env python
+"""Shim: `python train_clip.py ...` — CLIP trainer (beyond-reference capability)."""
+from dalle_pytorch_tpu.cli.train_clip import main
+
+if __name__ == "__main__":
+    main()
