@@ -7,7 +7,6 @@ pipeline and mesh-sharded step as train_dalle."""
 from __future__ import annotations
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp

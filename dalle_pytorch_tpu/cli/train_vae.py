@@ -8,11 +8,9 @@ from __future__ import annotations
 import argparse
 import math
 import time
-from pathlib import Path
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches

@@ -8,7 +8,6 @@ and the correctness oracle."""
 from __future__ import annotations
 
 import ctypes
-import os
 from pathlib import Path
 from typing import List
 

@@ -13,7 +13,7 @@ state in f32, forward/backward compute in bf16, gradient accumulation in f32
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from dalle_pytorch_tpu.core.pytree import cast_floating
 from dalle_pytorch_tpu.parallel.mesh import BATCH_AXES
-from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs, tree_shardings
+from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs
 
 P = PartitionSpec
 
