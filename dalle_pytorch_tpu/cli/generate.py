@@ -76,6 +76,9 @@ def main(argv=None):
     vae_params = trees["vae_weights"]
 
     tokenizer = get_tokenizer(args)
+    from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch
+
+    warn_vocab_mismatch(dalle_cfg.num_text_tokens, tokenizer)
     key = jax.random.PRNGKey(args.seed)
     outputs_dir = Path(args.outputs_dir)
 

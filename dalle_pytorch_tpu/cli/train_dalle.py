@@ -182,6 +182,10 @@ def main(argv=None):
         )
         start_params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), dalle_cfg)
 
+    from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch
+
+    warn_vocab_mismatch(dalle_cfg.num_text_tokens, tokenizer, is_root)
+
     # data
     be.check_batch_size(args.batch_size)
     if args.wds:

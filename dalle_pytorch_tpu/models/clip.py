@@ -86,7 +86,8 @@ def _patchify(cfg: CLIPConfig, images: jnp.ndarray) -> jnp.ndarray:
 
 
 def encode_text(params: dict, cfg: CLIPConfig, text: jnp.ndarray, text_mask=None) -> jnp.ndarray:
-    emb = jnp.take(params["text_emb"]["table"], text, axis=0)
+    # mode='clip': out-of-vocab ids would otherwise hit jnp.take's NaN fill
+    emb = jnp.take(params["text_emb"]["table"], text, axis=0, mode="clip")
     emb = emb + jnp.take(params["text_pos"]["table"], jnp.arange(text.shape[1]), axis=0)
     enc = apply_transformer(params["text_transformer"], cfg.text_transformer_config(), emb, key_mask=text_mask)
     if text_mask is not None:

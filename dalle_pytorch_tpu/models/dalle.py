@@ -156,8 +156,14 @@ def _image_table(params: dict, cfg: DALLEConfig) -> jnp.ndarray:
 
 
 def remap_and_bos(cfg: DALLEConfig, text: jnp.ndarray) -> jnp.ndarray:
-    """Give padding (id 0) a unique per-position id, then prepend <bos>=0."""
+    """Give padding (id 0) a unique per-position id, then prepend <bos>=0.
+
+    Ids are clamped into the raw text vocab first (before the pad remap):
+    out-of-range ids (e.g. a tokenizer whose vocab exceeds num_text_tokens)
+    would otherwise hit jnp.take's default out-of-bounds FILL behavior and
+    silently produce NaN embeddings (on every backend)."""
     b = text.shape[0]
+    text = jnp.clip(text, 0, cfg.num_text_tokens - 1)
     text_range = jnp.arange(cfg.text_seq_len) + (cfg.num_text_tokens_padded - cfg.text_seq_len)
     text = jnp.where(text == 0, text_range, text)
     return jnp.concatenate([jnp.zeros((b, 1), text.dtype), text], axis=1)
@@ -184,7 +190,7 @@ def image_pos_table(params: dict, cfg: DALLEConfig) -> Optional[jnp.ndarray]:
 
 def embed_image_codes(params: dict, cfg: DALLEConfig, codes: jnp.ndarray, start: int = 0) -> jnp.ndarray:
     """codes: (b, m) image code ids occupying raster positions start..start+m-1."""
-    emb = jnp.take(_image_table(params, cfg), codes, axis=0)
+    emb = jnp.take(_image_table(params, cfg), codes, axis=0, mode="clip")
     pos = image_pos_table(params, cfg)
     if pos is not None:
         emb = emb + jax.lax.dynamic_slice(pos, (start, 0), (codes.shape[1], pos.shape[1]))

@@ -213,7 +213,7 @@ def generate_texts(
     def step(cur, carry):
         buf, key = carry
         key, sk = jax.random.split(key)
-        emb = jnp.take(dalle_mod._text_table(params, cfg), buf, axis=0)
+        emb = jnp.take(dalle_mod._text_table(params, cfg), buf, axis=0, mode="clip")
         if not cfg.rotary_emb:
             emb = emb + jnp.take(params["text_pos"]["table"], jnp.arange(ts), axis=0)
         out = apply_transformer(params["transformer"], tcfg, emb)

@@ -80,6 +80,29 @@ def trained_dalle(workspace, trained_vae):
     return workspace / "dalle.pt"
 
 
+def test_out_of_vocab_ids_are_clamped_not_nan(trained_dalle):
+    """Regression guard: feeding real-tokenizer ids (vocab 49408) into a
+    num_text_tokens=64 model once hit jnp.take's out-of-bounds NaN fill;
+    the model clamps ids into vocab instead."""
+    import jax
+
+    from dalle_pytorch_tpu.data.tokenizer import tokenizer as tok
+    from dalle_pytorch_tpu.models import dalle as dalle_mod
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+    from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+    trees, meta = load_checkpoint(str(trained_dalle))
+    hparams = dict(meta["hparams"])
+    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
+        if hparams.get(k) is not None:
+            hparams[k] = tuple(hparams[k])
+    cfg = DALLEConfig(**hparams)
+    text = jax.numpy.asarray(tok.tokenize("a red circle", cfg.text_seq_len, truncate_text=True))
+    codes = jax.numpy.zeros((1, cfg.image_seq_len), int)
+    loss = dalle_mod.forward(trees["weights"], cfg, text, codes, return_loss=True)
+    assert np.isfinite(float(loss)), "out-of-vocab ids produced non-finite loss"
+
+
 def test_train_vae_cli(trained_vae):
     from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
 
