@@ -1,0 +1,35 @@
+import jax, jax.numpy as jnp, optax, time
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.parallel.train_step import StepSettings, make_train_step
+from dalle_pytorch_tpu.training.profiling import dalle_step_flops, matmul_param_count
+
+def bench(batch, execution="sequential", depth=8):
+    cfg = DALLEConfig(dim=2048, depth=depth, heads=16, dim_head=128,
+        num_text_tokens=10000, text_seq_len=256, num_image_tokens=8192, image_fmap_size=32,
+        attn_types=("full","axial_row","axial_col","conv_like"), shift_tokens=True,
+        rotary_emb=True, execution=execution, share_input_output_emb=True)
+    try:
+        params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+        def loss_fn(p, b, key):
+            return dalle_mod.forward(p, cfg, b["text"], b["image_codes"], return_loss=True)
+        init_fn, step_fn = make_train_step(loss_fn, optax.adam(1e-4), settings=StepSettings(compute_dtype=jnp.bfloat16))
+        state = init_fn(params)
+        nmm = matmul_param_count(state.params)
+        data = {"text": jax.random.randint(jax.random.PRNGKey(1), (batch, 256), 0, 10000),
+                "image_codes": jax.random.randint(jax.random.PRNGKey(2), (batch, 1024), 0, 8192)}
+        state, m = step_fn(state, data, jax.random.PRNGKey(0)); float(m["loss"])
+        times = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            state, m = step_fn(state, data, jax.random.PRNGKey(i)); float(m["loss"])
+            times.append(time.perf_counter()-t0)
+        t = min(times)
+        fl = dalle_step_flops(cfg, batch, nmm)
+        print(f"depth={depth} b={batch} {execution}: {t:.3f}s {batch*1024/t:.0f} tok/s mfu={fl/t/197e12:.3f}", flush=True)
+    except Exception as e:
+        print(f"depth={depth} b={batch} {execution}: FAILED {str(e)[:90]}", flush=True)
+
+bench(12)
+bench(16)
+bench(16, execution="remat")

@@ -369,20 +369,21 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     bwd_impl: str = "xla",  # 'xla' (fastest at seq ~1e3) | 'pallas' (O(n) memory)
+    live: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """(b, h, n, d) attention.  `mask`: optional static (n, n) bool pattern
     (True = may attend), combined with causality inside the kernel; a
     tile-liveness table is derived from it at trace time so fully-masked
-    tiles cost nothing.  q is expected UNSCALED (scale defaults to d^-1/2),
-    unlike ops.attention.attend."""
+    tiles cost nothing.  Pass `live` ((n/block_q, n/block_k) int32) explicitly
+    when the mask is traced (e.g. selected per-layer inside lax.scan).  q is
+    expected UNSCALED (scale defaults to d^-1/2), unlike ops.attention.attend."""
     b, h, n, d = q.shape
     if scale is None:
         scale = d ** -0.5
     block_q = min(block_q, n)
     block_k = min(block_k, n)
 
-    live = None
-    if mask is not None:
+    if mask is not None and live is None:
         try:  # static masks (the normal case) yield a tile-liveness table
             mask_np = np.asarray(mask)
             live = jnp.asarray(
@@ -391,7 +392,7 @@ def flash_attention(
                 .astype(np.int32)
             )
         except Exception:
-            live = None  # traced mask: no tile skipping
+            live = None  # traced mask without explicit live: no tile skipping
 
     qf = q.reshape(b * h, n, d)
     kf = k.reshape(b * h, n, d)

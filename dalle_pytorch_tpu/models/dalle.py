@@ -47,6 +47,7 @@ class DALLEConfig:
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     share_input_output_emb: bool = False
     execution: Optional[str] = None  # None -> 'reversible' if reversible else 'sequential'
+    scan_layers: bool = False  # lax.scan over layers (fast compiles at high depth)
     # image side, derived from the VAE that produced the codes
     num_image_tokens: int = 512
     image_fmap_size: int = 32
@@ -99,6 +100,7 @@ class DALLEConfig:
             shared_attn_ids=self.shared_attn_ids,
             shared_ff_ids=self.shared_ff_ids,
             execution=self.resolved_execution,
+            scan_layers=self.scan_layers,
             conv_kernel_size=self.conv_kernel_size,
             conv_dilation=self.conv_dilation,
             sparse_block_size=self.sparse_block_size,

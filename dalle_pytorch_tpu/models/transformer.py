@@ -62,6 +62,10 @@ class TransformerConfig:
     shared_attn_ids: Optional[Tuple[int, ...]] = None
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     execution: str = "sequential"  # 'sequential' | 'remat' | 'reversible'
+    # lax.scan over stacked layer params instead of an unrolled python loop:
+    # near-constant compile time in depth (essential for depth-64 configs).
+    # Requires unshared layers; composes with execution='remat'.
+    scan_layers: bool = False
     attn_kernel: str = "auto"  # 'auto' | 'flash' (Pallas) | 'xla' (dense masked)
     # sequence parallelism: shard activations' sequence dim over this mesh
     # axis between layers (GSPMD inserts the attention collectives); the
@@ -209,7 +213,7 @@ def _use_flash(cfg, n: int, key_mask) -> bool:
     return jax.default_backend() == "tpu"  # 'auto'
 
 
-def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey):
+def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
     b, n, _ = x.shape
     qkv = linear(shared["qkv"], x)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -223,7 +227,7 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey):
 
         pm = pattern[:n, :n] if pattern is not None else None
         out = flash_attention(
-            q, k, v, mask=pm, causal=cfg.causal, scale=cfg.dim_head ** -0.5
+            q, k, v, mask=pm, causal=cfg.causal, scale=cfg.dim_head ** -0.5, live=live
         )
         out = linear(shared["out"], _merge_heads(out))
         return apply_dropout(dkey, out, cfg.attn_dropout)
@@ -332,6 +336,9 @@ def apply_transformer(
         )
         return runner(params, x, keys)
 
+    if cfg.scan_layers:
+        return _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rotary)
+
     x = seq_constraint(x)
     for spec in specs:
         akey = layer_keys[spec.index, 0] if has_dropout else None
@@ -348,6 +355,79 @@ def apply_transformer(
         else:
             x = block(x)
     return x
+
+
+def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rotary):
+    """lax.scan over stacked per-layer params.  Per-layer attention patterns
+    become a traced select from a stacked mask array (with stacked Pallas
+    tile-liveness tables, so block skipping survives the scan)."""
+    import numpy as np
+
+    assert cfg.execution in ("sequential", "remat"), "scan_layers: sequential/remat only"
+    assert len({s.attn_id for s in specs}) == cfg.depth and len({s.ff_id for s in specs}) == cfg.depth, (
+        "scan_layers requires unshared layers (shared_attn_ids/shared_ff_ids unset)"
+    )
+    n = x.shape[1]
+
+    distinct = list(dict.fromkeys(s.attn_type for s in specs))
+    masks_np, lives_np = [], []
+    bq = min(128, n)
+    derive_live = n % bq == 0
+    for t in distinct:
+        pm = _pattern_for(cfg, t)
+        m = np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n]
+        masks_np.append(m)
+        if derive_live:
+            lives_np.append(
+                m.reshape(n // bq, bq, n // bq, bq).any(axis=(1, 3)).astype(np.int32)
+            )
+    masks = jnp.asarray(np.stack(masks_np))
+    lives = jnp.asarray(np.stack(lives_np)) if derive_live else None
+    midx = jnp.asarray([distinct.index(s.attn_type) for s in specs], jnp.int32)
+
+    bundles = [
+        {
+            "attn": params["shared_attn"][s.attn_id],
+            "ff": params["shared_ff"][s.ff_id],
+            "wrap": params["layers"][s.index],
+        }
+        for s in specs
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *bundles)
+
+    def run_branch(bundle, h, kind, mask, live, dkey):
+        wrap = bundle["wrap"]
+        y = layer_norm(wrap[f"{kind}_norm"], h)
+        if cfg.shift_tokens:
+            y = token_shift(y, cfg.seq_len, cfg.image_fmap_size)
+        if kind == "attn":
+            y = _attention_full(bundle["attn"], cfg, y, mask, rotary, key_mask, dkey, live=live)
+        else:
+            y = _feed_forward(bundle["ff"], cfg, y, dkey)
+        if cfg.sandwich_norm:
+            y = layer_norm(wrap[f"{kind}_norm_out"], y)
+        return y * wrap[f"{kind}_scale"].astype(y.dtype)
+
+    def body(h, xs):
+        if layer_keys is not None:
+            bundle, mi, keys2 = xs
+            akey, fkey = keys2[0], keys2[1]
+        else:
+            bundle, mi = xs
+            akey = fkey = None
+        mask = jnp.take(masks, mi, axis=0, mode="clip")
+        live = jnp.take(lives, mi, axis=0, mode="clip") if lives is not None else None
+        h = h + run_branch(bundle, h, "attn", mask, live, akey)
+        h = seq_constraint(h)
+        h = h + run_branch(bundle, h, "ff", mask, live, fkey)
+        return seq_constraint(h), None
+
+    if cfg.execution == "remat":
+        body = jax.checkpoint(body)
+
+    xs = (stacked, midx, layer_keys) if layer_keys is not None else (stacked, midx)
+    out, _ = jax.lax.scan(body, seq_constraint(x), xs)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -217,3 +217,30 @@ def test_key_padding_mask():
     b = apply_transformer(params, cfg, x.at[:, -1, 0].add(10.0), key_mask=km)
     # masked-out key may not influence other positions
     np.testing.assert_allclose(np.asarray(a)[:, :-1], np.asarray(b)[:, :-1], atol=1e-5)
+
+
+def test_scan_layers_matches_loop():
+    """scan_layers must be numerically identical to the unrolled loop,
+    including per-layer pattern selection and remat."""
+    for extra in (dict(), dict(execution="remat")):
+        cfg_loop = cfg_for(attn_types=("full", "axial_row", "conv_like"), depth=3,
+                           shift_tokens=True, **extra)
+        cfg_scan = cfg_for(attn_types=("full", "axial_row", "conv_like"), depth=3,
+                           shift_tokens=True, scan_layers=True, **extra)
+        params, x = make(cfg_loop)
+        a = np.asarray(apply_transformer(params, cfg_loop, x))
+        b = np.asarray(apply_transformer(params, cfg_scan, x))
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+        ga = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_loop, x) ** 2))(params)
+        gb = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_scan, x) ** 2))(params)
+        for la, lb in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4)
+
+
+def test_scan_layers_rejects_sharing():
+    cfg = cfg_for(depth=4, shared_attn_ids=(0, 0, 1, 1), scan_layers=True)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, cfg.seq_len, cfg.dim))
+    with pytest.raises(AssertionError, match="unshared"):
+        apply_transformer(params, cfg, x)
