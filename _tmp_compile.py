@@ -1,0 +1,29 @@
+import jax, jax.numpy as jnp, optax, time
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.parallel.train_step import StepSettings, make_train_step
+
+def timed(scan, depth=32):
+    cfg = DALLEConfig(dim=1024, depth=depth, heads=16, dim_head=64, num_text_tokens=10000,
+        text_seq_len=256, num_image_tokens=8192, image_fmap_size=32,
+        attn_types=("full","axial_row","axial_col","conv_like"), shift_tokens=True,
+        rotary_emb=True, execution="remat", scan_layers=scan)
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    def loss_fn(p, b, key):
+        return dalle_mod.forward(p, cfg, b["text"], b["image_codes"], return_loss=True)
+    init_fn, step_fn = make_train_step(loss_fn, optax.adam(1e-4), settings=StepSettings(compute_dtype=jnp.bfloat16))
+    state = init_fn(params)
+    data = {"text": jax.random.randint(jax.random.PRNGKey(1), (8, 256), 0, 10000),
+            "image_codes": jax.random.randint(jax.random.PRNGKey(2), (8, 1024), 0, 8192)}
+    t0 = time.perf_counter()
+    state, m = step_fn(state, data, jax.random.PRNGKey(0)); float(m["loss"])
+    compile_t = time.perf_counter() - t0
+    times = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, data, jax.random.PRNGKey(i)); float(m["loss"])
+        times.append(time.perf_counter()-t0)
+    print(f"scan={scan} depth={depth}: compile {compile_t:.1f}s step {min(times):.3f}s loss={float(m['loss']):.3f}", flush=True)
+
+timed(False)
+timed(True)

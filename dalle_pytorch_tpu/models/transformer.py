@@ -171,20 +171,30 @@ def transformer_rotary(cfg: TransformerConfig) -> Optional[jnp.ndarray]:
     return build_dalle_rotary(cfg.dim_head, cfg.text_len, cfg.image_fmap_size)
 
 
-def _pattern_for(cfg: TransformerConfig, attn_type: str) -> Optional[jnp.ndarray]:
-    """(seq_len, seq_len) pattern mask or None for 'full'."""
+def _pattern_for(cfg: TransformerConfig, attn_type: str):
+    """(seq_len, seq_len) NUMPY pattern mask or None for 'full'.
+
+    Kept as numpy (not jnp) deliberately: under jit, any jnp op on a constant
+    yields a tracer, which would defeat the Pallas kernel's trace-time
+    tile-liveness derivation.  Numpy slices stay concrete; conversion to a
+    device constant happens at the op boundary."""
     if attn_type == "full":
         return None
     if attn_type == "sparse":
-        return build_block_sparse_mask(
+        m = build_block_sparse_mask(
             cfg.seq_len,
             cfg.image_fmap_size,
             block_size=cfg.sparse_block_size,
             num_random_blocks=cfg.sparse_num_random_blocks,
         )
-    return build_pattern_mask(
-        attn_type, cfg.seq_len, cfg.image_fmap_size, cfg.conv_kernel_size, cfg.conv_dilation
-    )
+    else:
+        m = build_pattern_mask(
+            attn_type, cfg.seq_len, cfg.image_fmap_size,
+            cfg.conv_kernel_size, cfg.conv_dilation,
+        )
+    import numpy as np
+
+    return np.asarray(m)
 
 
 # ---------------------------------------------------------------------------
