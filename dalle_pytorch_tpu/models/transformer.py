@@ -37,7 +37,7 @@ from dalle_pytorch_tpu.core.module import (
 from dalle_pytorch_tpu.core.rng import KeyChain
 from dalle_pytorch_tpu.models.reversible import make_reversible_runner
 from dalle_pytorch_tpu.ops.attention import attend
-from dalle_pytorch_tpu.ops.masks import build_block_sparse_mask, build_pattern_mask
+from dalle_pytorch_tpu.ops.masks import build_block_sparse_mask, build_pattern_mask  # noqa: F401 (public re-export)
 from dalle_pytorch_tpu.ops.rotary import apply_rotary, build_dalle_rotary
 from dalle_pytorch_tpu.ops.shift import token_shift
 
@@ -178,23 +178,20 @@ def _pattern_for(cfg: TransformerConfig, attn_type: str):
     yields a tracer, which would defeat the Pallas kernel's trace-time
     tile-liveness derivation.  Numpy slices stay concrete; conversion to a
     device constant happens at the op boundary."""
+    from dalle_pytorch_tpu.ops.masks import _block_sparse_mask_np, _pattern_mask_np
+
     if attn_type == "full":
         return None
     if attn_type == "sparse":
-        m = build_block_sparse_mask(
-            cfg.seq_len,
-            cfg.image_fmap_size,
-            block_size=cfg.sparse_block_size,
-            num_random_blocks=cfg.sparse_num_random_blocks,
+        nr = cfg.sparse_num_random_blocks
+        if nr is None:
+            nr = cfg.seq_len // cfg.sparse_block_size // 4
+        return _block_sparse_mask_np(
+            cfg.seq_len, cfg.image_fmap_size, cfg.sparse_block_size, nr, 4, 0
         )
-    else:
-        m = build_pattern_mask(
-            attn_type, cfg.seq_len, cfg.image_fmap_size,
-            cfg.conv_kernel_size, cfg.conv_dilation,
-        )
-    import numpy as np
-
-    return np.asarray(m)
+    return _pattern_mask_np(
+        attn_type, cfg.seq_len, cfg.image_fmap_size, cfg.conv_kernel_size, cfg.conv_dilation
+    )
 
 
 # ---------------------------------------------------------------------------
