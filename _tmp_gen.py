@@ -1,0 +1,21 @@
+import jax, jax.numpy as jnp, time
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.sampling import sample_image_codes
+
+cfg = DALLEConfig(dim=2048, depth=8, heads=16, dim_head=128, num_text_tokens=10000,
+    text_seq_len=256, num_image_tokens=8192, image_fmap_size=32,
+    attn_types=("full","axial_row","axial_col","conv_like"), shift_tokens=True,
+    rotary_emb=True, share_input_output_emb=True)
+params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+text = jax.random.randint(jax.random.PRNGKey(1), (8, 256), 1, 10000)
+t0 = time.perf_counter()
+codes = sample_image_codes(params, cfg, text, jax.random.PRNGKey(2))
+codes.block_until_ready(); _ = int(codes[0,0])
+print(f"compile+first sample: {time.perf_counter()-t0:.1f}s", flush=True)
+for trial in range(2):
+    t0 = time.perf_counter()
+    codes = sample_image_codes(params, cfg, text, jax.random.PRNGKey(3+trial))
+    _ = int(codes[0,0])
+    dt = time.perf_counter()-t0
+    print(f"sample batch=8: {dt:.2f}s -> {dt/8:.3f}s/image, {8*1024/dt:.0f} tok/s", flush=True)

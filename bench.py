@@ -109,6 +109,21 @@ def main():
     flops = dalle_step_flops(cfg, batch, n_matmul)
     mfu = flops / step_time / _chip_peak()
 
+    # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same model
+    gen_s_per_image = None
+    if on_tpu:
+        from dalle_pytorch_tpu.core.pytree import cast_floating
+        from dalle_pytorch_tpu.models.sampling import sample_image_codes
+
+        gen_params = cast_floating(state.params, jnp.bfloat16)  # deployment dtype
+        text = jax.random.randint(jax.random.PRNGKey(5), (batch, cfg.text_seq_len), 1, cfg.num_text_tokens)
+        codes = sample_image_codes(gen_params, cfg, text, jax.random.PRNGKey(6))
+        int(codes[0, 0])  # force
+        t0 = time.perf_counter()
+        codes = sample_image_codes(gen_params, cfg, text, jax.random.PRNGKey(7))
+        int(codes[0, 0])
+        gen_s_per_image = (time.perf_counter() - t0) / batch
+
     print(json.dumps({
         "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280)" if on_tpu
                   else "img-tokens/sec/chip (CPU smoke)",
@@ -120,6 +135,7 @@ def main():
         "params_million": round(sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1),
         "batch": batch,
         "loss": final_loss,
+        "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "backend": jax.default_backend(),
     }))
 

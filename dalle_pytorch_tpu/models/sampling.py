@@ -89,7 +89,7 @@ def sample_image_codes(
         )
     n_pre = tokens.shape[1]
 
-    cache = init_cache(tcfg, bb)
+    cache = init_cache(tcfg, bb, dtype=params["logits_linear"]["w"].dtype)
     out, cache = prefill(params["transformer"], tcfg, tokens, cache)
     last_logits = _logits_at(params, cfg, out[:, -1:], n_pre - 1)
 

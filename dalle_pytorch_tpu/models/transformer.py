@@ -478,7 +478,7 @@ def _shift_cached_step(cfg, rb, x, offset):
     shifted = jnp.concatenate([top, left, cur[:, 2 * q :]], axis=-1)[:, None]
 
     pair = jnp.stack([cur[:, :q], cur[:, q : 2 * q]], axis=1)  # (b, 2, q)
-    rb = jax.lax.dynamic_update_index_in_dim(rb, pair[:, None], slot, axis=1)
+    rb = jax.lax.dynamic_update_index_in_dim(rb, pair[:, None].astype(rb.dtype), slot, axis=1)
     return shifted, rb
 
 

@@ -181,3 +181,15 @@ def test_noise_override_parity_mode():
                                       noise_override=noise2))
     np.testing.assert_array_equal(c, d)
     assert (c != a).any()
+
+
+def test_bf16_sampling():
+    """Deployment-dtype sampling: bf16 params through the cached decode."""
+    from dalle_pytorch_tpu.core.pytree import cast_floating
+
+    cfg = tiny_cfg()
+    params, text = setup(cfg)
+    p16 = cast_floating(params, jnp.bfloat16)
+    out = np.asarray(sample_image_codes(p16, cfg, text, jax.random.PRNGKey(0)))
+    assert out.shape == (2, cfg.image_seq_len)
+    assert (out >= 0).all() and (out < cfg.num_image_tokens).all()
