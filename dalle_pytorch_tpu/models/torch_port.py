@@ -1,0 +1,199 @@
+"""Port reference (lucidrains/DALLE-pytorch) torch state dicts to pytrees.
+
+Maps the reference module graph's state_dict names onto this framework's
+functional parameter pytrees, so checkpoints trained with the reference can be
+loaded directly and so numerical parity against the reference can be asserted
+(tests/test_reference_parity.py).
+
+Name sources (all in /root/reference/dalle_pytorch/):
+* DiscreteVAE      — dalle_pytorch.py:101-268 (encoder/decoder Sequentials,
+  ResBlock `net.{0,2,4}`, codebook embedding)
+* DALLE            — dalle_pytorch.py:352-456 (text/image embeddings, axial
+  positional `weights.{0,1}`, `to_logits.{0,1}`)
+* Transformer      — transformer.py:236-298: per layer
+  `layers.layers.{i}.{0|1}` = LayerScale(PreNorm(wrappers(Attention|FeedForward)))
+  where CachedAs/NonCached/PreShiftToken interpose parameter-free `fn` links;
+  reversible execution stores the same branches under
+  `layers.blocks.{i}.{f|g}.net` (reversible.py:20-66).
+
+Layout conversions: torch Linear weight (out, in) -> ours (in, out);
+torch Conv2d (O, I, kh, kw) -> HWIO; torch ConvTranspose2d (I, O, kh, kw) ->
+our input-dilated-conv kernel = spatially flipped HWIO.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.transformer import derive_layer_specs
+from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+
+
+def _np(v) -> np.ndarray:
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v, np.float32)
+
+
+def _conv(state: Dict, prefix: str) -> dict:
+    w = _np(state[f"{prefix}.weight"])  # (O, I, kh, kw)
+    out = {"w": jnp.asarray(np.transpose(w, (2, 3, 1, 0)))}
+    if f"{prefix}.bias" in state:
+        out["b"] = jnp.asarray(_np(state[f"{prefix}.bias"]))
+    return out
+
+
+def _conv_transpose(state: Dict, prefix: str) -> dict:
+    w = _np(state[f"{prefix}.weight"])  # (I, O, kh, kw)
+    w = np.transpose(w, (2, 3, 0, 1))[::-1, ::-1]  # flip spatial for dilated-conv form
+    out = {"w": jnp.asarray(np.ascontiguousarray(w))}
+    if f"{prefix}.bias" in state:
+        out["b"] = jnp.asarray(_np(state[f"{prefix}.bias"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DiscreteVAE
+# ---------------------------------------------------------------------------
+
+def convert_discrete_vae_state_dict(state: Dict, cfg: DiscreteVAEConfig) -> dict:
+    """Reference DiscreteVAE state_dict -> models.vae parameter pytree.
+
+    Sequential index layout (dalle_pytorch.py:145-165): encoder =
+    [Sequential(conv, relu)] * L + [ResBlock] * R + [final 1x1]; decoder =
+    ([1x1 in-proj] if R else []) + [ResBlock] * R + [Sequential(deconv, relu)]
+    * L + [final 1x1]."""
+    L, R = cfg.num_layers, cfg.num_resnet_blocks
+
+    def res_block(prefix: str) -> dict:
+        return {
+            "c1": _conv(state, f"{prefix}.net.0"),
+            "c2": _conv(state, f"{prefix}.net.2"),
+            "c3": _conv(state, f"{prefix}.net.4"),
+        }
+
+    params = {
+        "codebook": {"table": jnp.asarray(_np(state["codebook.weight"]))},
+        "enc_convs": [_conv(state, f"encoder.{i}.0") for i in range(L)],
+        "enc_res": [res_block(f"encoder.{L + j}") for j in range(R)],
+        "enc_out": _conv(state, f"encoder.{L + R}"),
+        "dec_res": [res_block(f"decoder.{1 + j}") for j in range(R)],
+        "dec_deconvs": [
+            _conv_transpose(state, f"decoder.{(1 + R if R else 0) + i}.0") for i in range(L)
+        ],
+        "dec_out": _conv(state, f"decoder.{(1 + R if R else 0) + L}"),
+    }
+    if R:
+        params["dec_in"] = _conv(state, "decoder.0")
+    return params
+
+
+# ---------------------------------------------------------------------------
+# DALLE
+# ---------------------------------------------------------------------------
+
+def convert_dalle_state_dict(state: Dict, cfg: DALLEConfig) -> dict:
+    """Reference DALLE state_dict -> models.dalle parameter pytree.
+
+    Handles sequential and reversible layer paths, weight sharing (shared
+    branches are written once per occurrence with identical tensors), sandwich
+    norms, and tied input/output embeddings.  `vae.*` entries are ignored (the
+    frozen VAE lives outside the DALLE pytree here)."""
+    tcfg = cfg.transformer_config()
+    specs = derive_layer_specs(tcfg)
+    dim, fmap = cfg.dim, cfg.image_fmap_size
+
+    layers: list = [
+        {} for _ in range(cfg.depth)
+    ]
+    shared_attn: Dict[str, dict] = {str(s.attn_id): {} for s in specs}
+    shared_ff: Dict[str, dict] = {str(s.ff_id): {} for s in specs}
+    params: dict = {
+        "transformer": {"shared_attn": shared_attn, "shared_ff": shared_ff, "layers": layers},
+    }
+
+    def transformer_leaf(i: int, branch: int, rest: list, key: str):
+        spec = specs[i]
+        kind = "attn" if branch == 0 else "ff"
+        layer = layers[i]
+        if rest == ["scale"]:
+            layer[f"{kind}_scale"] = jnp.asarray(_np(state[key]))
+        elif rest[0] == "norm":
+            layer.setdefault(f"{kind}_norm", {})[
+                "scale" if rest[1] == "weight" else "bias"
+            ] = jnp.asarray(_np(state[key]))
+        elif rest[0] == "norm_out":
+            layer.setdefault(f"{kind}_norm_out", {})[
+                "scale" if rest[1] == "weight" else "bias"
+            ] = jnp.asarray(_np(state[key]))
+        elif rest[:2] == ["to_qkv", "weight"]:
+            shared_attn[spec.attn_id]["qkv"] = {"w": jnp.asarray(_np(state[key]).T)}
+        elif rest[:2] == ["to_out", "0"]:
+            d = shared_attn[spec.attn_id].setdefault("out", {})
+            d["w" if rest[2] == "weight" else "b"] = jnp.asarray(
+                _np(state[key]).T if rest[2] == "weight" else _np(state[key])
+            )
+        elif rest[0] == "net" and rest[1] in ("0", "3"):
+            name = "w1" if rest[1] == "0" else "w2"
+            d = shared_ff[spec.ff_id].setdefault(name, {})
+            d["w" if rest[2] == "weight" else "b"] = jnp.asarray(
+                _np(state[key]).T if rest[2] == "weight" else _np(state[key])
+            )
+        else:
+            raise KeyError(f"unrecognized transformer entry: {key} (rest={rest})")
+
+    for key, val in state.items():
+        if key.startswith("vae.") or key == "logits_mask":
+            continue
+        if key == "text_emb.weight":
+            if not cfg.share_input_output_emb:
+                params["text_emb"] = {"table": jnp.asarray(_np(val))}
+        elif key == "image_emb.weight":
+            if not cfg.share_input_output_emb:
+                params["image_emb"] = {"table": jnp.asarray(_np(val))}
+        elif key.startswith(("text_emb.", "image_emb.")):
+            continue  # SharedEmbedding aliases of to_logits.1
+        elif key == "text_pos_emb.weight":
+            params["text_pos"] = {"table": jnp.asarray(_np(val))}
+        elif key == "image_pos_emb.weights.0":
+            params["image_pos_h"] = {"table": jnp.asarray(_np(val).reshape(fmap, dim))}
+        elif key == "image_pos_emb.weights.1":
+            params["image_pos_w"] = {"table": jnp.asarray(_np(val).reshape(fmap, dim))}
+        elif key.startswith("to_logits.0."):
+            params.setdefault("logits_norm", {})[
+                "scale" if key.endswith("weight") else "bias"
+            ] = jnp.asarray(_np(val))
+        elif key == "to_logits.1.weight":
+            params.setdefault("logits_linear", {})["w"] = jnp.asarray(_np(val).T)
+        elif key == "to_logits.1.bias":
+            params.setdefault("logits_linear", {})["b"] = jnp.asarray(_np(val))
+        elif key.startswith("transformer.layers."):
+            parts = key.split(".")
+            if parts[2] == "layers":  # SequentialSequence
+                i, branch, rest = int(parts[3]), int(parts[4]), parts[5:]
+            elif parts[2] == "blocks":  # ReversibleSequence: blocks.{i}.{f|g}.net
+                assert parts[5] == "net", key
+                i, branch, rest = int(parts[3]), (0 if parts[4] == "f" else 1), parts[6:]
+            else:
+                raise KeyError(f"unrecognized transformer container: {key}")
+            rest = [p for p in rest if p != "fn"]
+            transformer_leaf(i, branch, rest, key)
+        else:
+            raise KeyError(f"unrecognized DALLE state entry: {key}")
+
+    # structural check: every expected leaf must have been filled
+    from dalle_pytorch_tpu.models.dalle import init_dalle  # late import (cycle-free)
+    import jax
+
+    ref_struct = jax.tree_util.tree_structure(
+        init_dalle(jax.random.PRNGKey(0), cfg)
+    )
+    got_struct = jax.tree_util.tree_structure(params)
+    if ref_struct != got_struct:
+        raise ValueError(
+            f"converted pytree structure mismatch:\n got {got_struct}\nwant {ref_struct}"
+        )
+    return params

@@ -263,26 +263,107 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
 def _feed_forward(shared, cfg, x, dkey):
     h = linear(shared["w1"], x)
     a, gates = jnp.split(h, 2, axis=-1)
-    h = a * jax.nn.gelu(gates)
+    h = a * jax.nn.gelu(gates, approximate=False)  # exact erf, as the reference's F.gelu
     h = apply_dropout(dkey, h, cfg.ff_dropout)
     return linear(shared["w2"], h)
 
 
-def _branch(params, cfg, spec, x, kind, rotary, pattern, key_mask, dkey):
-    """One residual branch: PreShiftToken? -> PreNorm -> fn -> sandwich? -> LayerScale."""
-    layer = params["layers"][spec.index]
-    h = layer_norm(layer[f"{kind}_norm"], x)
+def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask):
+    """Length-n prefix attention that also fills the KV cache from offset 0.
+    Mutates layer_cache['k'/'v'] (caller passes a fresh dict copy)."""
+    b, n, _ = x.shape
+    qkv = linear(shared["qkv"], x)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_split_heads(t, cfg.heads) for t in (q, k, v))
+    if rotary is not None:
+        ang = rotary[:n]
+        q, k, v = (apply_rotary(ang, t) for t in (q, k, v))
+    q = q * (cfg.dim_head ** -0.5)
+    layer_cache["k"] = jax.lax.dynamic_update_slice(
+        layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, 0, 0)
+    )
+    layer_cache["v"] = jax.lax.dynamic_update_slice(
+        layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, 0, 0)
+    )
+    i_idx = jnp.arange(n)[:, None]
+    j_idx = jnp.arange(n)[None, :]
+    mask = j_idx <= i_idx
+    if pattern is not None:
+        mask = mask & pattern[:n, :n]
+    mask = mask[None, None]
+    if key_mask is not None:
+        mask = mask & key_mask[:, None, None, :n]
+    out = attend(q, k, v, mask=mask, stable=cfg.stable)
+    return linear(shared["out"], _merge_heads(out))
+
+
+def _residual_branch(
+    cfg,
+    wrap: dict,
+    attn_params: dict,
+    ff_params: dict,
+    x: jnp.ndarray,
+    kind: str,
+    mode: str = "full",  # 'full' | 'prefill' | 'decode'
+    rotary=None,
+    pattern=None,
+    key_mask=None,
+    dkey=None,
+    live=None,
+    layer_cache: Optional[dict] = None,
+    offset=None,
+):
+    """THE residual branch — PreShiftToken? -> PreNorm -> attn/ff -> sandwich?
+    -> LayerScale — shared by full-sequence apply, scan-layers, prefill and
+    single-token cached decode (the reference re-implements this composition
+    per wrapper; here every mode runs the one definition).  Returns
+    (branch output, updated layer cache or None)."""
+    h = layer_norm(wrap[f"{kind}_norm"], x)
     if cfg.shift_tokens:
-        h = token_shift(h, cfg.seq_len, cfg.image_fmap_size)
+        if mode == "decode":
+            layer_cache = dict(layer_cache)
+            h, layer_cache[f"shift_{kind}"] = _shift_cached_step(
+                cfg, layer_cache[f"shift_{kind}"], h, offset
+            )
+        else:
+            if mode == "prefill":
+                # raw (normed, pre-shift) values feed the ring buffer
+                layer_cache = dict(layer_cache)
+                layer_cache[f"shift_{kind}"] = _fill_ring(cfg, layer_cache[f"shift_{kind}"], h)
+            h = token_shift(h, cfg.seq_len, cfg.image_fmap_size)
     if kind == "attn":
-        h = _attention_full(
-            params["shared_attn"][spec.attn_id], cfg, h, pattern, rotary, key_mask, dkey
-        )
+        if mode == "full":
+            h = _attention_full(attn_params, cfg, h, pattern, rotary, key_mask, dkey, live=live)
+        elif mode == "prefill":
+            layer_cache = dict(layer_cache)
+            h = _attention_prefill(attn_params, cfg, layer_cache, h, pattern, rotary, key_mask)
+        else:
+            layer_cache = dict(layer_cache)
+            h, (layer_cache["k"], layer_cache["v"]) = _attention_cached(
+                attn_params, cfg, layer_cache, h, pattern, rotary, offset
+            )
     else:
-        h = _feed_forward(params["shared_ff"][spec.ff_id], cfg, h, dkey)
+        h = _feed_forward(ff_params, cfg, h, dkey)
     if cfg.sandwich_norm:
-        h = layer_norm(layer[f"{kind}_norm_out"], h)
-    return h * layer[f"{kind}_scale"].astype(h.dtype)
+        h = layer_norm(wrap[f"{kind}_norm_out"], h)
+    return h * wrap[f"{kind}_scale"].astype(h.dtype), layer_cache
+
+
+def _branch(params, cfg, spec, x, kind, rotary, pattern, key_mask, dkey):
+    """Full-sequence residual branch addressed by layer spec."""
+    out, _ = _residual_branch(
+        cfg,
+        params["layers"][spec.index],
+        params["shared_attn"][spec.attn_id],
+        params["shared_ff"][spec.ff_id],
+        x,
+        kind,
+        rotary=rotary,
+        pattern=pattern,
+        key_mask=key_mask,
+        dkey=dkey,
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -403,17 +484,11 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *bundles)
 
     def run_branch(bundle, h, kind, mask, live, dkey):
-        wrap = bundle["wrap"]
-        y = layer_norm(wrap[f"{kind}_norm"], h)
-        if cfg.shift_tokens:
-            y = token_shift(y, cfg.seq_len, cfg.image_fmap_size)
-        if kind == "attn":
-            y = _attention_full(bundle["attn"], cfg, y, mask, rotary, key_mask, dkey, live=live)
-        else:
-            y = _feed_forward(bundle["ff"], cfg, y, dkey)
-        if cfg.sandwich_norm:
-            y = layer_norm(wrap[f"{kind}_norm_out"], y)
-        return y * wrap[f"{kind}_scale"].astype(y.dtype)
+        out, _ = _residual_branch(
+            cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, kind,
+            rotary=rotary, pattern=mask, key_mask=key_mask, dkey=dkey, live=live,
+        )
+        return out
 
     def body(h, xs):
         if layer_keys is not None:
@@ -509,6 +584,32 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset):
     return out, (k_buf, v_buf)
 
 
+def _run_cached_layers(cfg: TransformerConfig, specs, x, cache, branch):
+    """Drive `branch(spec, x, kind, layer_cache) -> (out, layer_cache)` through
+    the layer stack (sequential residual or reversible twin-stream), returning
+    (output, new layer caches)."""
+    new_layers = []
+    if cfg.execution == "reversible":
+        x1 = x2 = x
+        for spec in specs:
+            layer_cache = cache["layers"][spec.index]
+            fa, layer_cache = branch(spec, x2, "attn", layer_cache)
+            x1 = x1 + fa
+            fb, layer_cache = branch(spec, x1, "ff", layer_cache)
+            x2 = x2 + fb
+            new_layers.append(layer_cache)
+        return (x1 + x2) / 2, new_layers
+    h = x
+    for spec in specs:
+        layer_cache = cache["layers"][spec.index]
+        fa, layer_cache = branch(spec, h, "attn", layer_cache)
+        h = h + fa
+        fb, layer_cache = branch(spec, h, "ff", layer_cache)
+        h = h + fb
+        new_layers.append(layer_cache)
+    return h, new_layers
+
+
 def decode_step(
     params: dict,
     cfg: TransformerConfig,
@@ -523,47 +624,15 @@ def decode_step(
     patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
     offset = cache["offset"]
 
-    new_layers = []
+    def branch(spec, x, kind, layer_cache):
+        return _residual_branch(
+            cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
+            params["shared_ff"][spec.ff_id], x, kind, mode="decode",
+            rotary=rotary, pattern=patterns[spec.attn_type],
+            layer_cache=layer_cache, offset=offset,
+        )
 
-    def run_branch(spec, x, kind, layer_cache):
-        layer_cache = dict(layer_cache)
-        layer = params["layers"][spec.index]
-        h = layer_norm(layer[f"{kind}_norm"], x)
-        if cfg.shift_tokens:
-            h, layer_cache[f"shift_{kind}"] = _shift_cached_step(
-                cfg, layer_cache[f"shift_{kind}"], h, offset
-            )
-        if kind == "attn":
-            h, (layer_cache["k"], layer_cache["v"]) = _attention_cached(
-                params["shared_attn"][spec.attn_id], cfg, layer_cache, h,
-                patterns[spec.attn_type], rotary, offset,
-            )
-        else:
-            h = _feed_forward(params["shared_ff"][spec.ff_id], cfg, h, None)
-        if cfg.sandwich_norm:
-            h = layer_norm(layer[f"{kind}_norm_out"], h)
-        return h * layer[f"{kind}_scale"].astype(h.dtype), layer_cache
-
-    if cfg.execution == "reversible":
-        x1 = x2 = x
-        for spec in specs:
-            layer_cache = cache["layers"][spec.index]
-            fa, layer_cache = run_branch(spec, x2, "attn", layer_cache)
-            x1 = x1 + fa
-            fb, layer_cache = run_branch(spec, x1, "ff", layer_cache)
-            x2 = x2 + fb
-            new_layers.append(layer_cache)
-        out = (x1 + x2) / 2
-    else:
-        for spec in specs:
-            layer_cache = cache["layers"][spec.index]
-            fa, layer_cache = run_branch(spec, x, "attn", layer_cache)
-            x = x + fa
-            fb, layer_cache = run_branch(spec, x, "ff", layer_cache)
-            x = x + fb
-            new_layers.append(layer_cache)
-        out = x
-
+    out, new_layers = _run_cached_layers(cfg, specs, x, cache, branch)
     return out, {"offset": offset + 1, "layers": new_layers}
 
 
@@ -576,75 +645,20 @@ def prefill(
 ) -> Tuple[jnp.ndarray, dict]:
     """Consume a length-n prefix starting at offset 0, filling the KV cache and
     shift ring buffers, and return the transformer output for the prefix."""
-    b, n, _ = x.shape
+    n = x.shape[1]
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
     patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
 
-    new_layers = []
+    def branch(spec, x, kind, layer_cache):
+        return _residual_branch(
+            cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
+            params["shared_ff"][spec.ff_id], x, kind, mode="prefill",
+            rotary=rotary, pattern=patterns[spec.attn_type], key_mask=key_mask,
+            layer_cache=layer_cache,
+        )
 
-    def run_branch(spec, x, kind, layer_cache):
-        layer = params["layers"][spec.index]
-        h = layer_norm(layer[f"{kind}_norm"], x)
-        if cfg.shift_tokens:
-            pre_shift = h  # raw (normed) values feed the ring buffer
-            h = token_shift(h, cfg.seq_len, cfg.image_fmap_size)
-            layer_cache = dict(layer_cache)
-            layer_cache[f"shift_{kind}"] = _fill_ring(cfg, layer_cache[f"shift_{kind}"], pre_shift)
-        if kind == "attn":
-            shared = params["shared_attn"][spec.attn_id]
-            qkv = linear(shared["qkv"], h)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q, k, v = (_split_heads(t, cfg.heads) for t in (q, k, v))
-            if rotary is not None:
-                ang = rotary[:n]
-                q, k, v = (apply_rotary(ang, t) for t in (q, k, v))
-            q = q * (cfg.dim_head ** -0.5)
-            layer_cache = dict(layer_cache)
-            layer_cache["k"] = jax.lax.dynamic_update_slice(
-                layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, 0, 0)
-            )
-            layer_cache["v"] = jax.lax.dynamic_update_slice(
-                layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, 0, 0)
-            )
-            i_idx = jnp.arange(n)[:, None]
-            j_idx = jnp.arange(n)[None, :]
-            mask = j_idx <= i_idx
-            pattern = patterns[spec.attn_type]
-            if pattern is not None:
-                mask = mask & pattern[:n, :n]
-            mask = mask[None, None]
-            if key_mask is not None:
-                mask = mask & key_mask[:, None, None, :n]
-            h = attend(q, k, v, mask=mask, stable=cfg.stable)
-            h = linear(shared["out"], _merge_heads(h))
-        else:
-            h = _feed_forward(params["shared_ff"][spec.ff_id], cfg, h, None)
-        if cfg.sandwich_norm:
-            h = layer_norm(layer[f"{kind}_norm_out"], h)
-        return h * layer[f"{kind}_scale"].astype(h.dtype), layer_cache
-
-    if cfg.execution == "reversible":
-        x1 = x2 = x
-        for spec in specs:
-            layer_cache = cache["layers"][spec.index]
-            fa, layer_cache = run_branch(spec, x2, "attn", layer_cache)
-            x1 = x1 + fa
-            fb, layer_cache = run_branch(spec, x1, "ff", layer_cache)
-            x2 = x2 + fb
-            new_layers.append(layer_cache)
-        out = (x1 + x2) / 2
-    else:
-        h = x
-        for spec in specs:
-            layer_cache = cache["layers"][spec.index]
-            fa, layer_cache = run_branch(spec, h, "attn", layer_cache)
-            h = h + fa
-            fb, layer_cache = run_branch(spec, h, "ff", layer_cache)
-            h = h + fb
-            new_layers.append(layer_cache)
-        out = h
-
+    out, new_layers = _run_cached_layers(cfg, specs, x, cache, branch)
     return out, {"offset": jnp.asarray(n, jnp.int32), "layers": new_layers}
 
 

@@ -222,14 +222,17 @@ def forward(
     else:
         recon = jnp.mean((target - out) ** 2)
 
-    # KL(q || uniform), summed over cells and classes, averaged over batch —
-    # the reference's kl_div(log_uniform, log_qy, 'batchmean', log_target=True)
+    # KL(q || uniform), summed over batch, cells and classes.  The reference's
+    # kl_div(log_uniform, log_qy, 'batchmean', log_target=True) passes a
+    # shape-(1,) input, so 'batchmean' divides by 1 — the effective reduction
+    # is a FULL sum (verified against torch; parity-tested in
+    # tests/test_reference_parity.py::test_dvae_loss_parity).
     b = logits.shape[0]
     flat = logits.reshape(b, -1, cfg.num_tokens)
     log_qy = jax.nn.log_softmax(flat, axis=-1)
     log_uniform = -jnp.log(jnp.asarray(cfg.num_tokens, jnp.float32))
     qy = jnp.exp(log_qy)
-    kl = jnp.sum(qy * (log_qy - log_uniform)) / b
+    kl = jnp.sum(qy * (log_qy - log_uniform))
 
     loss = recon + kl * cfg.kl_div_loss_weight
     if not return_recons:

@@ -66,6 +66,13 @@ def build_dalle_rotary(dim_head: int, text_len: int, image_fmap_size: int) -> jn
 
     table = np.concatenate([lang_part, axial_part], axis=-1)
     assert table.shape[-1] <= dim_head, "rotary dims exceed head dim"
+    # pad to dim_head with zero angles: cos=1/sin=0 rotates the tail channels
+    # by the identity, so apply_rotary is ONE fused elementwise pass with no
+    # slice/concat round-trips through HBM
+    if table.shape[-1] < dim_head:
+        pad = dim_head - table.shape[-1]
+        pad -= pad % 2  # rotation mixes channel pairs; keep an odd tail out
+        table = np.pad(table, ((0, 0), (0, pad)))
     return jnp.asarray(table, dtype=jnp.float32)
 
 
@@ -79,10 +86,16 @@ def _rotate_pairs(x: jnp.ndarray) -> jnp.ndarray:
 def apply_rotary(angles: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
     """Rotate the first `angles.shape[-1]` channels of t, pass the rest through.
 
-    angles: (n, rot) or (..., n, rot); t: (..., n, dim_head)."""
+    angles: (n, rot) or (..., n, rot); t: (..., n, dim_head).  The rotation
+    runs in t's dtype (cos/sin of the constant table are folded by XLA and
+    cast once), so on bf16 activations this is a single memory-bound pass with
+    no f32 intermediates."""
     rot = angles.shape[-1]
     dtype = t.dtype
+    cos = jnp.cos(angles).astype(dtype)
+    sin = jnp.sin(angles).astype(dtype)
+    if rot == t.shape[-1]:
+        return t * cos + _rotate_pairs(t) * sin
     t_rot, t_pass = t[..., :rot], t[..., rot:]
-    t32 = t_rot.astype(jnp.float32)
-    out = t32 * jnp.cos(angles) + _rotate_pairs(t32) * jnp.sin(angles)
-    return jnp.concatenate([out.astype(dtype), t_pass], axis=-1)
+    out = t_rot * cos + _rotate_pairs(t_rot) * sin
+    return jnp.concatenate([out, t_pass], axis=-1)

@@ -17,8 +17,10 @@ def test_rotary_table_shape():
     text_len = 17  # text_seq_len 16 + bos
     seq_len = 16 + fmap * fmap
     table = build_dalle_rotary(dim_head, text_len, fmap)
-    # rot_dim = 21 -> lang part 22 dims, pixel part 2*10*2 = 40 dims
-    assert table.shape == (text_len + fmap * fmap, 62)
+    # rot_dim = 21 -> lang part 22 dims, pixel part 2*10*2 = 40 dims = 62
+    # active columns, zero-angle-padded to dim_head for a single fused pass
+    assert table.shape == (text_len + fmap * fmap, 64)
+    assert np.all(np.asarray(table[:, 62:]) == 0.0)
     assert table.shape[0] == seq_len + 1
 
 

@@ -74,7 +74,10 @@ def test_kl_matches_manual():
     logits = np.asarray(dvae.encode_logits(params, cfg, img)).reshape(2, -1, cfg.num_tokens)
     logq = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
     q = np.exp(logq)
-    manual = (q * (logq + np.log(cfg.num_tokens))).sum() / 2
+    # full sum, no batch division: the reference's kl_div(..., 'batchmean')
+    # receives a shape-(1,) input so 'batchmean' divides by 1 (parity-tested
+    # in test_reference_parity.py::test_dvae_loss_parity)
+    manual = (q * (logq + np.log(cfg.num_tokens))).sum()
     assert kl == pytest.approx(manual, rel=1e-3)
 
 
