@@ -280,8 +280,13 @@ def forward(
     )
     assert labels.shape[1] == cfg.total_seq_len
 
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # CE as gather - logsumexp: same math as log_softmax+gather but never
+    # materializes a second (b, n, vocab) f32 tensor (XLA streams the
+    # reduction over the bf16 logits)
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+    label_logit = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    token_ll = label_logit - lse
     loss_text = -jnp.mean(token_ll[:, : cfg.text_seq_len])
     loss_img = -jnp.mean(token_ll[:, cfg.text_seq_len :])
     return (loss_text + cfg.loss_img_weight * loss_img) / (cfg.loss_img_weight + 1)

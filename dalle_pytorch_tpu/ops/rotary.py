@@ -15,6 +15,7 @@ pairs as (x, y) -> (x cos - y sin, x sin + y cos).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -83,6 +84,18 @@ def _rotate_pairs(x: jnp.ndarray) -> jnp.ndarray:
     return rotated.reshape(x.shape)
 
 
+def _pair_swap_matrix(d: int) -> np.ndarray:
+    """(d, d) constant S with x @ S == _rotate_pairs(x).  On TPU the stride-2
+    lane interleave lowers to slow cross-lane shuffles; a tiny matmul against
+    this +-1 matrix runs on the MXU (exact in bf16: one nonzero per column)
+    and fuses with the surrounding elementwise rotation."""
+    S = np.zeros((d, d), np.float32)
+    i = np.arange(0, d, 2)
+    S[i + 1, i] = -1.0
+    S[i, i + 1] = 1.0
+    return S
+
+
 def apply_rotary(angles: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
     """Rotate the first `angles.shape[-1]` channels of t, pass the rest through.
 
@@ -95,7 +108,12 @@ def apply_rotary(angles: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
     cos = jnp.cos(angles).astype(dtype)
     sin = jnp.sin(angles).astype(dtype)
     if rot == t.shape[-1]:
-        return t * cos + _rotate_pairs(t) * sin
+        if jax.default_backend() == "tpu":
+            swap = jnp.asarray(_pair_swap_matrix(rot), dtype)
+            pt = jnp.einsum("...nd,de->...ne", t, swap, preferred_element_type=dtype)
+        else:
+            pt = _rotate_pairs(t)
+        return t * cos + pt * sin
     t_rot, t_pass = t[..., :rot], t[..., rot:]
     out = t_rot * cos + _rotate_pairs(t_rot) * sin
     return jnp.concatenate([out, t_pass], axis=-1)
