@@ -140,14 +140,22 @@ class CLIP:
 
 
 class OpenAIDiscreteVAE:
-    """Pretrained OpenAI dVAE (weights converted from the published pickles
-    via models/openai_vae.load_openai_vae)."""
+    """Pretrained OpenAI dVAE.  With no arguments the published pickles are
+    downloaded to the cache and converted once (reference vae.py:104-117);
+    explicit encoder/decoder paths skip the download."""
 
-    def __init__(self, encoder_path: str, decoder_path: str):
+    def __init__(self, encoder_path: Optional[str] = None, decoder_path: Optional[str] = None):
         from dalle_pytorch_tpu.models import openai_vae as _ovae
 
-        self.cfg = _ovae.OpenAIVAEConfig()
-        self.params = _ovae.load_openai_vae(encoder_path, decoder_path)
+        if (encoder_path is None) != (decoder_path is None):
+            raise ValueError("provide both encoder_path and decoder_path, or neither")
+        if encoder_path is None:
+            from dalle_pytorch_tpu.models.pretrained import load_openai_vae_pretrained
+
+            self.params, self.cfg = load_openai_vae_pretrained()
+        else:
+            self.cfg = _ovae.OpenAIVAEConfig()
+            self.params = _ovae.load_openai_vae(encoder_path, decoder_path)
         self._mod = _ovae
 
     image_size = 256
@@ -166,10 +174,17 @@ class VQGanVAE:
     """Pretrained taming VQGAN/GumbelVQ (weights converted from a checkpoint
     via models/vqgan.load_vqgan)."""
 
-    def __init__(self, vqgan_model_path: str, vqgan_config: Optional[dict] = None):
+    def __init__(self, vqgan_model_path: Optional[str] = None, vqgan_config: Optional[dict] = None):
         from dalle_pytorch_tpu.models import vqgan as _vqgan
 
-        self.params, self.cfg = _vqgan.load_vqgan(vqgan_model_path, vqgan_config)
+        if vqgan_model_path is None:
+            if vqgan_config is not None:
+                raise ValueError("a custom vqgan_config requires its vqgan_model_path")
+            from dalle_pytorch_tpu.models.pretrained import load_vqgan_pretrained
+
+            self.params, self.cfg = load_vqgan_pretrained()
+        else:
+            self.params, self.cfg = _vqgan.load_vqgan(vqgan_model_path, vqgan_config)
         self._mod = _vqgan
 
     @property

@@ -13,9 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
+from dalle_pytorch_tpu.models import vae_registry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts
-from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
 from dalle_pytorch_tpu.version import __version__
 
@@ -60,9 +60,6 @@ def main(argv=None):
     assert path.exists(), f"trained DALL-E {path} does not exist"
 
     trees, meta = load_checkpoint(str(path))
-    assert meta.get("vae_class_name", "DiscreteVAE") == "DiscreteVAE", (
-        f"unsupported VAE class {meta.get('vae_class_name')} in checkpoint"
-    )
     if meta.get("version") != __version__:
         print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
 
@@ -71,7 +68,11 @@ def main(argv=None):
         if hparams.get(k) is not None:
             hparams[k] = tuple(hparams[k])
     dalle_cfg = DALLEConfig(**hparams)
-    vae_cfg = DiscreteVAEConfig(**meta["vae_params"])
+    # reference generate.py:94-101: reconstitute whichever VAE class the
+    # checkpoint was trained with
+    vae_cfg = vae_registry.config_from_meta(
+        meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
+    )
     params = trees["weights"]
     vae_params = trees["vae_weights"]
 

@@ -19,7 +19,7 @@ import optax
 from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
 from dalle_pytorch_tpu.data.loader import TextImageDataset, batch_tar_stream, iterate_batches, iterate_tar_shards
 from dalle_pytorch_tpu.models import dalle as dalle_mod
-from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.models import vae_registry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
@@ -38,6 +38,12 @@ def build_parser() -> argparse.ArgumentParser:
     group.add_argument("--dalle_path", type=str, default=None, help="path to partially-trained DALL-E to resume")
     parser.add_argument("--image_text_folder", type=str, required=True,
                         help="folder of image+text files, or a glob of .tar shards with --wds")
+    parser.add_argument("--taming", action="store_true",
+                        help="use a pretrained taming VQGAN as the image tokenizer")
+    parser.add_argument("--vqgan_model_path", type=str, default=None,
+                        help="taming checkpoint (.ckpt); downloads the published default when omitted")
+    parser.add_argument("--vqgan_config_path", type=str, default=None,
+                        help="taming config yaml matching --vqgan_model_path")
     parser.add_argument("--wds", action="store_true", help="treat image_text_folder as tar shards")
     parser.add_argument("--truncate_captions", action="store_true")
     parser.add_argument("--random_resize_crop_lower_ratio", type=float, default=0.75)
@@ -105,22 +111,33 @@ def get_tokenizer(args):
     return tokenizer_mod.tokenizer
 
 
-def reconstitute_vae(args):
-    """Load the frozen VAE (weights + config) that tokenizes training images."""
-    assert args.vae_path is not None or args.dalle_path is not None, (
-        "either --vae_path (new run) or --dalle_path (resume) is required"
-    )
-    path = args.vae_path
-    if path is None:
-        # resume: the dalle checkpoint carries vae weights + params
-        trees, meta = load_checkpoint(args.dalle_path)
+def reconstitute_vae(args, resume=None):
+    """Load the frozen VAE (weights + config) that tokenizes training images —
+    a trained DiscreteVAE checkpoint, a taming VQGAN, or the OpenAI dVAE
+    (reference train_dalle.py:246-293).  `resume` is the already-loaded
+    (trees, meta) of the dalle checkpoint, which carries the VAE."""
+    if resume is not None:
+        trees, meta = resume
         assert "vae_weights" in trees, "resume checkpoint is missing VAE weights"
-        return trees["vae_weights"], DiscreteVAEConfig(**meta["vae_params"])
-    trees, meta = load_checkpoint(path)
-    return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
+        cfg = vae_registry.config_from_meta(
+            meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
+        )
+        return trees["vae_weights"], cfg
+    if args.vae_path is not None:
+        trees, meta = load_checkpoint(args.vae_path)
+        return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
+    from dalle_pytorch_tpu.models import pretrained
+
+    if args.taming:
+        return pretrained.load_vqgan_pretrained(
+            args.vqgan_model_path, args.vqgan_config_path
+        )
+    print("using OpenAI's pretrained VAE for encoding images to tokens")
+    return pretrained.load_openai_vae_pretrained()
 
 
 def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
+    class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
     save_checkpoint(
         path,
         trees={
@@ -130,10 +147,10 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
         },
         meta={
             "hparams": dalle_cfg.to_dict(),
-            "vae_params": vae_cfg.to_dict(),
+            "vae_params": vae_meta,
             "epoch": epoch,
             "version": __version__,
-            "vae_class_name": "DiscreteVAE",
+            "vae_class_name": class_name,
             "scheduler_state": None,
         },
     )
@@ -152,11 +169,12 @@ def main(argv=None):
     is_root = be.is_root_worker()
 
     tokenizer = get_tokenizer(args)
-    vae_params, vae_cfg = reconstitute_vae(args)
+    resume = load_checkpoint(args.dalle_path) if args.dalle_path is not None else None
+    vae_params, vae_cfg = reconstitute_vae(args, resume)
 
     resume_meta = None
-    if args.dalle_path is not None:
-        trees, resume_meta = load_checkpoint(args.dalle_path)
+    if resume is not None:
+        trees, resume_meta = resume
         dalle_cfg = DALLEConfig(**_tupled(resume_meta["hparams"]))
         start_params = trees["weights"]
     else:
@@ -220,7 +238,7 @@ def main(argv=None):
 
     # loss: raw pixels -> frozen VAE codes -> DALLE CE loss
     def loss_fn(params, batch, key):
-        codes = vae_mod.get_codebook_indices(vae_params, vae_cfg, batch["image"])
+        codes = vae_registry.get_codebook_indices(vae_params, vae_cfg, batch["image"])
         return dalle_mod.forward(
             params, dalle_cfg, batch["text"], jax.lax.stop_gradient(codes),
             return_loss=True, key=key,

@@ -157,16 +157,18 @@ def generate_images(
     clip_params: Optional[dict] = None,
     clip_cfg=None,
 ):
-    """Full pipeline: sample codes, decode through the VAE, optionally score
-    with CLIP.  img: optional (b, H, W, C) raw pixels for priming."""
+    """Full pipeline: sample codes, decode through the VAE (any family —
+    DiscreteVAE / VQGAN / OpenAI dVAE, dispatched on the config type),
+    optionally score with CLIP.  img: optional (b, H, W, C) raw pixels for
+    priming."""
     from dalle_pytorch_tpu.models import clip as clip_mod
-    from dalle_pytorch_tpu.models import vae as vae_mod
+    from dalle_pytorch_tpu.models import vae_registry
 
     text = text[:, : cfg.text_seq_len]
     primer = None
     prime_len = 0
     if img is not None:
-        indices = vae_mod.get_codebook_indices(vae_params, vae_cfg, img)
+        indices = vae_registry.get_codebook_indices(vae_params, vae_cfg, img)
         prime_len = (
             num_init_img_tokens
             if num_init_img_tokens is not None
@@ -180,7 +182,7 @@ def generate_images(
         filter_thres=filter_thres, temperature=temperature, cond_scale=cond_scale,
         primer_codes=primer, prime_len=prime_len,
     )
-    images = vae_mod.decode_indices(vae_params, vae_cfg, codes)
+    images = vae_registry.decode_indices(vae_params, vae_cfg, codes)
 
     if clip_params is not None:
         scores = clip_mod.forward(clip_params, clip_cfg, text, images)

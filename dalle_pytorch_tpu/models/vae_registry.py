@@ -1,0 +1,71 @@
+"""Uniform dispatch over the three VAE families.
+
+The reference reconstitutes its image tokenizer from a trained `vae.pt`, a
+taming VQGAN (`--taming`), or the OpenAI dVAE
+(/root/reference/train_dalle.py:246-293, generate.py:94-99) and tags
+checkpoints with `vae_class_name` (generate.py:101).  Here every family
+already exposes the same functional surface — `get_codebook_indices(params,
+cfg, images)` / `decode_indices(params, cfg, img_seq)` over a config carrying
+`num_tokens` / `image_size` / `num_layers` — so dispatch is a config-type
+lookup, and the trainer/sampler are VAE-class agnostic.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from dalle_pytorch_tpu.models import openai_vae as _openai_mod
+from dalle_pytorch_tpu.models import vae as _dvae_mod
+from dalle_pytorch_tpu.models import vqgan as _vqgan_mod
+from dalle_pytorch_tpu.models.openai_vae import OpenAIVAEConfig
+from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+from dalle_pytorch_tpu.models.vqgan import VQGANConfig
+
+_BY_CONFIG = {
+    DiscreteVAEConfig: ("DiscreteVAE", _dvae_mod),
+    VQGANConfig: ("VQGanVAE", _vqgan_mod),
+    OpenAIVAEConfig: ("OpenAIDiscreteVAE", _openai_mod),
+}
+
+
+def vae_class_name(vae_cfg: Any) -> str:
+    return _BY_CONFIG[type(vae_cfg)][0]
+
+
+def vae_module(vae_cfg: Any):
+    return _BY_CONFIG[type(vae_cfg)][1]
+
+
+def get_codebook_indices(vae_params: Dict, vae_cfg: Any, images):
+    return vae_module(vae_cfg).get_codebook_indices(vae_params, vae_cfg, images)
+
+
+def decode_indices(vae_params: Dict, vae_cfg: Any, img_seq):
+    return vae_module(vae_cfg).decode_indices(vae_params, vae_cfg, img_seq)
+
+
+def config_from_meta(class_name: str, vae_params_meta: Dict) -> Any:
+    """Rebuild the VAE config from checkpoint metadata (`vae_class_name` +
+    the config dict saved under `vae_params`)."""
+    if class_name == "DiscreteVAE":
+        return DiscreteVAEConfig(**_tupled(vae_params_meta, ()))
+    if class_name == "VQGanVAE":
+        return VQGANConfig(**_tupled(vae_params_meta, ("ch_mult", "attn_resolutions")))
+    if class_name == "OpenAIDiscreteVAE":
+        return OpenAIVAEConfig()
+    raise ValueError(f"unknown vae_class_name {class_name!r}")
+
+
+def config_to_meta(vae_cfg: Any) -> Tuple[str, Dict]:
+    return vae_class_name(vae_cfg), vae_cfg.to_dict()
+
+
+def _tupled(meta: Dict, tuple_keys) -> Dict:
+    out = dict(meta)
+    out.pop("class", None)
+    for k in tuple_keys:
+        if out.get(k) is not None:
+            out[k] = tuple(out[k])
+    # DiscreteVAEConfig.normalization round-trips json as nested lists
+    if isinstance(out.get("normalization"), list):
+        out["normalization"] = tuple(tuple(t) for t in out["normalization"])
+    return out

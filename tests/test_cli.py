@@ -177,3 +177,68 @@ def test_train_clip_cli(workspace):
         "--truncate_captions", "--save_every_n_steps", "0",
     ])
     assert (workspace / "clip.pt").exists()
+
+
+def test_train_dalle_taming_and_generate(workspace):
+    """Reference train_dalle.py:246-293 / generate.py:94-99: train on top of a
+    pretrained taming VQGAN (--taming) and generate from the resulting
+    checkpoint, whose vae_class_name dispatches the right decoder."""
+    import torch
+    import yaml
+    from taming_fixture import make_taming_state_dict
+
+    from dalle_pytorch_tpu.models.vqgan import VQGANConfig
+    from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+    # consistent geometry: 1 halving (ch_mult len 2) == f-factor 16/8
+    cfg = VQGANConfig(
+        ch=8, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+        resolution=16, z_channels=8, n_embed=32, embed_dim=8,
+    )
+    ckpt_path = workspace / "vqgan_tiny.ckpt"
+    torch.save({"state_dict": make_taming_state_dict(cfg)}, str(ckpt_path))
+    config_path = workspace / "vqgan_tiny.yml"
+    config_path.write_text(yaml.safe_dump({
+        "model": {"params": {
+            "n_embed": 32, "embed_dim": 8,
+            "ddconfig": {
+                "ch": 8, "ch_mult": [1, 2], "num_res_blocks": 1,
+                "attn_resolutions": [8], "in_channels": 3, "out_ch": 3,
+                "resolution": 16, "z_channels": 8,
+            },
+        }},
+    }))
+
+    state, dcfg = train_dalle_cli.main([
+        "--taming",
+        "--vqgan_model_path", str(ckpt_path),
+        "--vqgan_config_path", str(config_path),
+        "--image_text_folder", str(workspace / "data"),
+        "--dim", "32",
+        "--depth", "1",
+        "--heads", "2",
+        "--dim_head", "8",
+        "--text_seq_len", "16",
+        "--num_text_tokens", "64",
+        "--epochs", "1",
+        "--batch_size", "8",
+        "--save_every_n_steps", "0",
+        "--sample_every_n_steps", "0",
+        "--dalle_output_file_name", str(workspace / "dalle_taming"),
+        "--truncate_captions",
+    ])
+    assert dcfg.num_image_tokens == 32 and dcfg.image_fmap_size == 8
+
+    ckpt = workspace / "dalle_taming.pt"
+    _, meta = load_checkpoint(str(ckpt))
+    assert meta["vae_class_name"] == "VQGanVAE"
+
+    paths = generate_cli.main([
+        "--dalle_path", str(ckpt),
+        "--text", "a red circle",
+        "--num_images", "1",
+        "--batch_size", "1",
+        "--outputs_dir", str(workspace / "outputs_taming"),
+    ])
+    assert len(paths) == 1
+    assert Image.open(paths[0]).size == (16, 16)

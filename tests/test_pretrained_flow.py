@@ -1,0 +1,175 @@
+"""Download/cache/convert-once flow (models/pretrained.py) with a
+monkeypatched fetcher — parity with the reference's rank-coordinated
+download (vae.py:55-96) plus the TPU-native convert-once cache."""
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import pretrained
+
+
+class FakeBackend:
+    """Single-process stand-in recording barrier calls."""
+
+    def __init__(self, is_root=True):
+        self._root = is_root
+        self.barriers = 0
+
+    def is_local_root_worker(self):
+        return self._root
+
+    def local_barrier(self):
+        self.barriers += 1
+
+
+def make_fetcher(payload=b"weights", log=None):
+    log = log if log is not None else []
+
+    def fetch(url, dst):
+        log.append(url)
+        with open(dst, "wb") as f:
+            f.write(payload)
+
+    fetch.log = log
+    return fetch
+
+
+def test_download_fetches_once_then_hits_cache(tmp_path):
+    fetch = make_fetcher()
+    p1 = pretrained.download("http://x/enc.pkl", root=tmp_path, fetcher=fetch, backend=None)
+    p2 = pretrained.download("http://x/enc.pkl", root=tmp_path, fetcher=fetch, backend=None)
+    assert p1 == p2 == tmp_path / "enc.pkl"
+    assert p1.read_bytes() == b"weights"
+    assert fetch.log == ["http://x/enc.pkl"]  # second call served from cache
+
+
+def test_download_strips_query_and_honors_filename(tmp_path):
+    fetch = make_fetcher()
+    p = pretrained.download("http://x/ckpt?dl=1", root=tmp_path, fetcher=fetch)
+    assert p.name == "ckpt"
+    p = pretrained.download("http://x/ckpt?dl=1", "model.ckpt", root=tmp_path, fetcher=fetch)
+    assert p.name == "model.ckpt"
+
+
+def test_download_barrier_count_is_cache_independent(tmp_path):
+    """Every process must join the same number of barriers regardless of its
+    cache state — the backend barrier is a global collective, so divergent
+    participation (host A cached, host B not) would deadlock."""
+    fetch = make_fetcher()
+    be_cold = FakeBackend(is_root=True)
+    pretrained.download("http://x/w.pkl", root=tmp_path, fetcher=fetch, backend=be_cold)
+    assert be_cold.barriers == 1  # root barriers after the rename
+
+    be_warm = FakeBackend(is_root=True)  # simulates a host with a warm cache
+    pretrained.download("http://x/w.pkl", root=tmp_path, fetcher=fetch, backend=be_warm)
+    assert be_warm.barriers == 1  # same collective count as the cold host
+    assert fetch.log == ["http://x/w.pkl"]  # but no second fetch
+
+
+def test_download_nonroot_waits_then_reads(tmp_path):
+    fetch = make_fetcher()
+
+    class WaitingBackend(FakeBackend):
+        def local_barrier(self):
+            super().local_barrier()
+            # simulate the root finishing its download during the barrier
+            (tmp_path / "w.pkl").write_bytes(b"from-root")
+
+    be = WaitingBackend(is_root=False)
+    p = pretrained.download("http://x/w.pkl", root=tmp_path, fetcher=fetch, backend=be)
+    assert be.barriers == 1
+    assert p.read_bytes() == b"from-root"
+    assert fetch.log == []  # the non-root worker never fetches
+
+
+def test_openai_pretrained_converts_once(tmp_path, monkeypatch):
+    """No-arg OpenAI flow: fetch both pickles, convert once to a pytree
+    checkpoint, and serve later calls offline from the converted file."""
+    from dalle_pytorch_tpu.models import openai_vae
+
+    tiny = {"encoder": {"w": np.ones((2, 2), np.float32)},
+            "decoder": {"b": np.zeros((3,), np.float32)}}
+    calls = []
+
+    def fake_load(enc_path, dec_path):
+        calls.append((enc_path, dec_path))
+        return tiny
+
+    monkeypatch.setattr(openai_vae, "load_openai_vae", fake_load)
+    fetch = make_fetcher()
+
+    params, cfg = pretrained.load_openai_vae_pretrained(cache_dir=tmp_path, fetcher=fetch)
+    assert isinstance(cfg, openai_vae.OpenAIVAEConfig)
+    np.testing.assert_array_equal(params["encoder"]["w"], tiny["encoder"]["w"])
+    assert len(fetch.log) == 2 and len(calls) == 1
+    assert (tmp_path / "openai_vae_converted.npz").exists()
+
+    # second call: offline — neither fetch nor torch conversion runs
+    params2, _ = pretrained.load_openai_vae_pretrained(cache_dir=tmp_path, fetcher=fetch)
+    assert len(fetch.log) == 2 and len(calls) == 1
+    np.testing.assert_array_equal(params2["decoder"]["b"], tiny["decoder"]["b"])
+
+
+def test_vqgan_pretrained_default_download(tmp_path):
+    """--taming with no explicit paths downloads the published checkpoint and
+    config into the cache and loads through the taming converter."""
+    import torch
+    import yaml
+    from taming_fixture import make_taming_state_dict
+
+    from dalle_pytorch_tpu.models.vqgan import VQGANConfig
+
+    cfg = VQGANConfig(
+        ch=8, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+        resolution=16, z_channels=8, n_embed=32, embed_dim=8,
+    )
+    blobs = {}
+    ckpt_file = tmp_path / "blob.ckpt"
+    torch.save({"state_dict": make_taming_state_dict(cfg)}, str(ckpt_file))
+    blobs[pretrained.VQGAN_VAE_URL] = ckpt_file.read_bytes()
+    blobs[pretrained.VQGAN_VAE_CONFIG_URL] = yaml.safe_dump({
+        "model": {"params": {
+            "n_embed": 32, "embed_dim": 8,
+            "ddconfig": {"ch": 8, "ch_mult": [1, 2], "num_res_blocks": 1,
+                         "attn_resolutions": [8], "in_channels": 3, "out_ch": 3,
+                         "resolution": 16, "z_channels": 8},
+        }},
+    }).encode()
+
+    log = []
+
+    def fetch(url, dst):
+        log.append(url)
+        with open(dst, "wb") as f:
+            f.write(blobs[url])
+
+    cache = tmp_path / "cache"
+    params, got_cfg = pretrained.load_vqgan_pretrained(cache_dir=cache, fetcher=fetch)
+    assert got_cfg.n_embed == 32 and got_cfg.resolution == 16
+    assert (cache / pretrained.VQGAN_FILENAME).exists()
+    assert (cache / pretrained.VQGAN_CONFIG_FILENAME).exists()
+    assert len(log) == 2
+
+    # round 2: served from cache
+    pretrained.load_vqgan_pretrained(cache_dir=cache, fetcher=fetch)
+    assert len(log) == 2
+
+
+def test_vae_registry_meta_roundtrip():
+    from dalle_pytorch_tpu.models import vae_registry
+    from dalle_pytorch_tpu.models.openai_vae import OpenAIVAEConfig
+    from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
+    from dalle_pytorch_tpu.models.vqgan import VQGANConfig
+
+    import json
+
+    for cfg in (
+        DiscreteVAEConfig(image_size=16, num_tokens=32, num_layers=2),
+        VQGANConfig(ch=8, ch_mult=(1, 2), attn_resolutions=(8,), resolution=16),
+        OpenAIVAEConfig(),
+    ):
+        name, meta = vae_registry.config_to_meta(cfg)
+        # checkpoint meta survives a json round trip (tuples become lists)
+        back = vae_registry.config_from_meta(name, json.loads(json.dumps(meta)))
+        assert type(back) is type(cfg)
+        assert back.num_tokens == cfg.num_tokens
+        assert back.image_size == cfg.image_size

@@ -50,67 +50,10 @@ def test_gumbel_variant():
 def test_converter_structure_matches_random_init():
     """Build a fake taming state dict for the small config and check the
     converter produces the same tree structure as init_random_like."""
+    from taming_fixture import make_taming_state_dict
+
     cfg = small_cfg()
-    rng = np.random.RandomState(0)
-    state = {}
-
-    def put_conv(name, cin, cout, k):
-        state[f"{name}.weight"] = rng.randn(cout, cin, k, k).astype(np.float32)
-        state[f"{name}.bias"] = rng.randn(cout).astype(np.float32)
-
-    def put_gn(name, c):
-        state[f"{name}.weight"] = np.ones(c, np.float32)
-        state[f"{name}.bias"] = np.zeros(c, np.float32)
-
-    def put_res(prefix, cin, cout):
-        put_gn(f"{prefix}.norm1", cin)
-        put_conv(f"{prefix}.conv1", cin, cout, 3)
-        put_gn(f"{prefix}.norm2", cout)
-        put_conv(f"{prefix}.conv2", cout, cout, 3)
-        if cin != cout:
-            put_conv(f"{prefix}.nin_shortcut", cin, cout, 1)
-
-    def put_attn(prefix, c):
-        put_gn(f"{prefix}.norm", c)
-        for n in ("q", "k", "v", "proj_out"):
-            put_conv(f"{prefix}.{n}", c, c, 1)
-
-    widths = [cfg.ch * m for m in cfg.ch_mult]
-    put_conv("encoder.conv_in", 3, cfg.ch, 3)
-    cin, res = cfg.ch, cfg.resolution
-    for lvl, w in enumerate(widths):
-        for i in range(cfg.num_res_blocks):
-            put_res(f"encoder.down.{lvl}.block.{i}", cin, w)
-            if res in cfg.attn_resolutions:
-                put_attn(f"encoder.down.{lvl}.attn.{i}", w)
-            cin = w
-        if lvl != len(widths) - 1:
-            put_conv(f"encoder.down.{lvl}.downsample.conv", w, w, 3)
-            res //= 2
-    put_res("encoder.mid.block_1", cin, cin)
-    put_attn("encoder.mid.attn_1", cin)
-    put_res("encoder.mid.block_2", cin, cin)
-    put_gn("encoder.norm_out", cin)
-    put_conv("encoder.conv_out", cin, cfg.z_channels, 3)
-    put_conv("quant_conv", cfg.z_channels, cfg.embed_dim, 1)
-    put_conv("post_quant_conv", cfg.embed_dim, cfg.z_channels, 1)
-    put_conv("decoder.conv_in", cfg.z_channels, widths[-1], 3)
-    cin = widths[-1]
-    put_res("decoder.mid.block_1", cin, cin)
-    put_attn("decoder.mid.attn_1", cin)
-    put_res("decoder.mid.block_2", cin, cin)
-    # taming applies decoder.up[levels-1] first (widest), down to up[0]
-    for lvl in reversed(range(len(widths))):
-        w = widths[lvl]
-        for i in range(cfg.num_res_blocks + 1):
-            put_res(f"decoder.up.{lvl}.block.{i}", cin, w)
-            cin = w
-        if lvl != 0:
-            put_conv(f"decoder.up.{lvl}.upsample.conv", w, w, 3)
-    put_gn("decoder.norm_out", cin)
-    put_conv("decoder.conv_out", cin, 3, 3)
-    state["quantize.embedding.weight"] = rng.randn(cfg.n_embed, cfg.embed_dim).astype(np.float32)
-
+    state = make_taming_state_dict(cfg)
     params = vqgan.convert_taming_state_dict(state, cfg)
     img = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3))
     idx = vqgan.get_codebook_indices(params, cfg, img)
