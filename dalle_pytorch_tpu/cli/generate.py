@@ -115,6 +115,9 @@ def main(argv=None):
             )
             from PIL import Image
 
+            # display space (the reference's save_image(normalize=True),
+            # generate.py:138-141 — DiscreteVAE decodes into normalized space)
+            images = vae_registry.to_display(vae_cfg, images)
             for img in np.asarray(images):
                 arr = (np.clip(img, 0, 1) * 255).astype(np.uint8)
                 fp = out_dir / f"{produced}.png"

@@ -126,6 +126,11 @@ def reconstitute_vae(args, resume=None):
     if args.vae_path is not None:
         trees, meta = load_checkpoint(args.vae_path)
         return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
+    if (args.vqgan_model_path or args.vqgan_config_path) and not args.taming:
+        raise SystemExit(
+            "--vqgan_model_path/--vqgan_config_path require --taming "
+            "(otherwise they would be silently ignored)"
+        )
     from dalle_pytorch_tpu.models import pretrained
 
     if args.taming:
@@ -331,23 +336,19 @@ def main(argv=None):
 
 
 def _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, batch, tokenizer, step):
+    """Generated-sample logging at the sampling cadence (reference
+    train_dalle.py:639-649: wandb.Image of a generation for the first
+    caption in the batch)."""
     try:
         text = batch["text"][:1]
         images = generate_images(
             state.params, dalle_cfg, vae_params, vae_cfg, text, jax.random.PRNGKey(step)
         )
-        arr = np.asarray(images[0])
+        arr = np.asarray(vae_registry.to_display(vae_cfg, images[0]))
         caption = tokenizer.decode(np.asarray(text[0]))
-        logger.log({"sample_caption": caption, "sample_min": float(arr.min()),
-                    "sample_max": float(arr.max())}, step=step, quiet=True)
-        try:
-            from PIL import Image
-
-            Path("samples").mkdir(exist_ok=True)
-            arr8 = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
-            Image.fromarray(arr8.squeeze()).save(f"samples/step{step}.png")
-        except Exception:
-            pass
+        logger.log({"sample_min": float(arr.min()), "sample_max": float(arr.max())},
+                   step=step, quiet=True)
+        logger.log_images({"image": arr}, step=step, captions={"image": caption})
     except Exception as e:  # sampling must never kill training
         print(f"[sample] generation failed: {e!r}")
 

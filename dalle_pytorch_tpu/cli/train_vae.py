@@ -111,10 +111,20 @@ def main(argv=None):
         return params, opt_state, loss
 
     @jax.jit
-    def codebook_usage(params, images):
-        idx = vae_mod.get_codebook_indices(params, cfg, images)
-        counts = jnp.bincount(idx.reshape(-1), length=cfg.num_tokens)
-        return jnp.sum(counts > 0)
+    def codebook_indices(params, images):
+        return vae_mod.get_codebook_indices(params, cfg, images)
+
+    @jax.jit
+    def recon_pair(params, images, key, temp):
+        """(soft recon via the gumbel path, hard recon via argmax codes) —
+        the two grids the reference logs (train_vae.py:252-266)."""
+        soft = vae_mod.forward(params, cfg, images, key=key, temp=temp)
+        hard = vae_mod.decode_indices(
+            params, cfg, vae_mod.get_codebook_indices(params, cfg, images)
+        )
+        return soft, hard
+
+    denorm = lambda x: vae_mod.denormalize_images(cfg, x)  # noqa: E731
 
     # fail fast on unwritable output before burning compute
     save_model(f"{args.vae_output_file_name}.pt", params, cfg)
@@ -136,12 +146,28 @@ def main(argv=None):
             if global_step % 100 == 0:
                 # temperature annealing (reference train_vae.py:276-278)
                 temp = max(temp * math.exp(-args.anneal_rate * global_step), args.temp_min)
-                used = int(codebook_usage(params, jnp.asarray(images)))
+                idx = codebook_indices(params, jnp.asarray(images))
+                used = int(jnp.sum(jnp.bincount(idx.reshape(-1), length=cfg.num_tokens) > 0))
                 logger.log(
                     {"loss": float(loss), "temperature": temp, "lr": lr,
                      "codebook_used": used, "epoch": epoch},
                     step=global_step,
                 )
+                if is_root:
+                    # recon grids + hard recons + codebook histogram
+                    # (reference train_vae.py:252-271)
+                    k = min(args.num_images_save, images.shape[0])
+                    sample = jnp.asarray(images[:k])
+                    soft, hard = recon_pair(params, sample, sk, jnp.asarray(temp))
+                    logger.log_images(
+                        {
+                            "original images": sample,
+                            "reconstructions": denorm(soft),
+                            "hard reconstructions": denorm(hard),
+                        },
+                        step=global_step,
+                    )
+                    logger.log_histogram("codebook_indices", idx, step=global_step)
             if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
                 save_model(f"{args.vae_output_file_name}.pt", params, cfg)
             global_step += 1

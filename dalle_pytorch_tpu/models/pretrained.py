@@ -145,35 +145,42 @@ def load_vqgan_pretrained(
     backend=None,
 ):
     """Taming VQGAN: explicit checkpoint/config paths, or the published
-    ImageNet f16-1024 default downloaded to the cache (vae.py:162-170).
+    ImageNet f16-1024 default downloaded to the cache (vae.py:162-170) and
+    converted once to a torch-free pytree checkpoint.
     Returns (params, VQGANConfig)."""
+    from dalle_pytorch_tpu.models.vae_registry import config_from_meta
     from dalle_pytorch_tpu.models.vqgan import load_vqgan
 
     root = Path(cache_dir or default_cache_dir())
     backend = backend if backend is not None else _current_backend()
-    if model_path is None:
-        model_path = str(
-            download(VQGAN_VAE_URL, VQGAN_FILENAME, root=root, fetcher=fetcher, backend=backend)
-        )
-        if config_path is None:
-            config_path = str(
-                download(
-                    VQGAN_VAE_CONFIG_URL, VQGAN_CONFIG_FILENAME,
-                    root=root, fetcher=fetcher, backend=backend,
-                )
-            )
-    elif config_path is None:
-        # silently assuming the published f16/1024 geometry for a custom
-        # checkpoint would mis-convert it (same contract as the reference's
-        # VQGanVAE assert, vae.py:164)
-        raise ValueError("a custom vqgan_model_path requires its vqgan_config_path")
 
-    config = None
-    if config_path is not None:
+    def parse_config(path: str) -> dict:
         import yaml
 
-        with open(config_path) as f:
+        with open(path) as f:
             config = yaml.safe_load(f)
         if isinstance(config, dict) and "model" in config:
             config = config["model"]
-    return load_vqgan(model_path, config)
+        return config
+
+    if model_path is not None:
+        if config_path is None:
+            # silently assuming the published f16/1024 geometry for a custom
+            # checkpoint would mis-convert it (same contract as the
+            # reference's VQGanVAE assert, vae.py:164)
+            raise ValueError("a custom vqgan_model_path requires its vqgan_config_path")
+        return load_vqgan(model_path, parse_config(config_path))
+
+    # published default: coordinated download + convert-once (later runs and
+    # non-root ranks load the pytree with no torch in the loop)
+    ckpt = download(VQGAN_VAE_URL, VQGAN_FILENAME, root=root, fetcher=fetcher, backend=backend)
+    cfg_file = download(
+        VQGAN_VAE_CONFIG_URL, VQGAN_CONFIG_FILENAME, root=root, fetcher=fetcher, backend=backend
+    )
+
+    def convert():
+        params, cfg = load_vqgan(str(ckpt), parse_config(str(cfg_file)))
+        return {"params": params}, {"vqgan_config": cfg.to_dict()}
+
+    trees, meta = _convert_once(root / "vqgan_default_converted.npz", backend, convert)
+    return trees["params"], config_from_meta("VQGanVAE", meta["vqgan_config"])

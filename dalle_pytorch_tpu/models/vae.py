@@ -129,6 +129,16 @@ def normalize_images(cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray
     return (images - means) / stds
 
 
+def denormalize_images(cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of normalize_images, clipped to display space [0, 1] (decoder
+    outputs live in normalized space)."""
+    if cfg.normalization is not None:
+        means = jnp.asarray(cfg.normalization[0][: cfg.channels], images.dtype)
+        stds = jnp.asarray(cfg.normalization[1][: cfg.channels], images.dtype)
+        images = images * stds + means
+    return jnp.clip(images, 0.0, 1.0)
+
+
 def encode_logits(params: dict, cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
     """Normalized conv stack -> per-cell codebook logits (B, h, w, num_tokens)."""
     x = normalize_images(cfg, images)

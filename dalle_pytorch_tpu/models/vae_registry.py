@@ -43,6 +43,17 @@ def decode_indices(vae_params: Dict, vae_cfg: Any, img_seq):
     return vae_module(vae_cfg).decode_indices(vae_params, vae_cfg, img_seq)
 
 
+def to_display(vae_cfg: Any, images):
+    """Decoded images -> display space [0, 1].  DiscreteVAE decodes into its
+    normalized space (the reference compensates with save_image(normalize=
+    True), generate.py:138-141); VQGAN/OpenAI decoders already emit [0, 1]."""
+    if isinstance(vae_cfg, DiscreteVAEConfig):
+        return _dvae_mod.denormalize_images(vae_cfg, images)
+    import jax.numpy as jnp
+
+    return jnp.clip(images, 0.0, 1.0)
+
+
 def config_from_meta(class_name: str, vae_params_meta: Dict) -> Any:
     """Rebuild the VAE config from checkpoint metadata (`vae_class_name` +
     the config dict saved under `vae_params`)."""

@@ -303,10 +303,16 @@ def convert_taming_state_dict(state: Dict, cfg: VQGANConfig) -> Dict:
 
 
 def load_vqgan(model_path: str, config: Optional[dict] = None) -> Tuple[Dict, VQGANConfig]:
-    """Load a taming checkpoint (torch .ckpt with 'state_dict') and optional
-    ddconfig dict (from the published yaml).  torch needed at load time only."""
+    """Load a taming checkpoint (torch .ckpt with 'state_dict') and its
+    ddconfig dict (from the matching yaml).  torch needed at load time only.
+    The config is required: assuming the published f16/1024 geometry for an
+    arbitrary checkpoint would mis-convert it (the reference's VQGanVAE has
+    the same both-or-neither contract, vae.py:163-166)."""
     import torch
 
+    if not config:
+        raise ValueError("load_vqgan requires the checkpoint's config dict "
+                         "(parsed from its taming yaml)")
     ckpt = torch.load(model_path, map_location="cpu", weights_only=False)
     state = ckpt.get("state_dict", ckpt)
     cfg_kwargs = {}

@@ -1,12 +1,33 @@
 """Metrics logging: wandb when available and requested (capability parity
 with the reference's W&B instrumentation, SURVEY.md §5), always mirrored to
-stdout + a JSONL file so headless runs keep observability."""
+stdout + a JSONL file so headless runs keep observability.  Images land as
+wandb.Image *and* PNGs in a per-run directory; histograms as wandb.Histogram
+*and* JSONL bin counts — the reference's collapse-detection and
+eyeball-the-samples workflows (train_vae.py:252-271, train_dalle.py:639-649)
+survive headless."""
 from __future__ import annotations
 
 import json
 import time
 from pathlib import Path
 from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def make_grid(images: np.ndarray, nrow: int = 4, pad: int = 2) -> np.ndarray:
+    """(N, H, W, C) floats in [0, 1] -> one (gh, gw, C) grid image (the
+    torchvision make_grid the reference logs, in numpy/NHWC)."""
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    ncol = min(nrow, n)
+    nr = (n + ncol - 1) // ncol
+    grid = np.ones((nr * (h + pad) + pad, ncol * (w + pad) + pad, c), images.dtype)
+    for i in range(n):
+        r, col = divmod(i, ncol)
+        y, x = pad + r * (h + pad), pad + col * (w + pad)
+        grid[y : y + h, x : x + w] = images[i]
+    return grid
 
 
 class MetricLogger:
@@ -16,6 +37,7 @@ class MetricLogger:
         self.is_root = is_root
         self._wandb = None
         self._file = None
+        self._image_dir = Path(log_dir) / f"{run_name}.images"
         if not is_root:
             return
         if use_wandb:
@@ -29,6 +51,57 @@ class MetricLogger:
         path = Path(log_dir) / f"{run_name}.metrics.jsonl"
         path.parent.mkdir(parents=True, exist_ok=True)
         self._file = open(path, "a")
+
+    def log_images(self, images: Dict[str, Any], step: Optional[int] = None,
+                   captions: Optional[Dict[str, str]] = None):
+        """images: name -> (H, W, C) or (N, H, W, C) floats in [0, 1]
+        (batches become a grid).  Logged as wandb.Image when wandb is active,
+        and always written as PNGs under <run>.images/ with a JSONL record."""
+        if not self.is_root:
+            return
+        captions = captions or {}
+        record: Dict[str, Any] = {}
+        wandb_payload = {}
+        for name, arr in images.items():
+            arr = np.asarray(arr, np.float32)
+            if arr.ndim == 4:
+                arr = make_grid(arr)
+            arr8 = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+            if arr8.shape[-1] == 1:
+                arr8 = arr8[..., 0]
+            fname = f"step{step}_{name.replace(' ', '_')}.png" if step is not None else f"{name}.png"
+            self._image_dir.mkdir(parents=True, exist_ok=True)
+            out_path = self._image_dir / fname
+            try:
+                from PIL import Image
+
+                Image.fromarray(arr8).save(out_path)
+                record[name] = str(out_path)
+            except Exception as e:  # pragma: no cover
+                record[name] = f"<png save failed: {e!r}>"
+            if self._wandb is not None:
+                wandb_payload[name] = self._wandb.Image(arr8, caption=captions.get(name))
+        if self._wandb is not None and wandb_payload:
+            self._wandb.log(wandb_payload, step=step)
+        self.log({"images": record, **{f"{k}_caption": v for k, v in captions.items()}},
+                 step=step, quiet=True)
+
+    def log_histogram(self, name: str, values, step: Optional[int] = None, bins: int = 64):
+        """Distribution logging (the reference's codebook-usage
+        wandb.Histogram): wandb.Histogram when active, plus JSONL bin
+        counts/edges for headless collapse detection."""
+        if not self.is_root:
+            return
+        values = np.asarray(values).reshape(-1)
+        counts, edges = np.histogram(values, bins=bins)
+        if self._wandb is not None:
+            self._wandb.log({name: self._wandb.Histogram(np_histogram=(counts, edges))}, step=step)
+        self.log(
+            {f"{name}_hist": {"counts": counts.tolist(),
+                              "edges": [float(edges[0]), float(edges[-1])],
+                              "distinct": int(len(np.unique(values)))}},
+            step=step, quiet=True,
+        )
 
     def log(self, metrics: Dict[str, Any], step: Optional[int] = None, quiet: bool = False):
         if not self.is_root:

@@ -242,3 +242,41 @@ def test_train_dalle_taming_and_generate(workspace):
     ])
     assert len(paths) == 1
     assert Image.open(paths[0]).size == (16, 16)
+
+
+def test_train_vae_image_and_histogram_logging(workspace, trained_vae):
+    """Observability parity (reference train_vae.py:252-271): recon grids,
+    hard recons, and the codebook-usage histogram land at the log cadence."""
+    import json
+
+    img_dir = workspace / "vae.images"
+    for name in ("original_images", "reconstructions", "hard_reconstructions"):
+        p = img_dir / f"step0_{name}.png"
+        assert p.exists(), p
+        assert Image.open(p).size[0] > 16  # a grid, not a single tile
+    records = [json.loads(l) for l in open(workspace / "vae.metrics.jsonl")]
+    hists = [r["codebook_indices_hist"] for r in records if "codebook_indices_hist" in r]
+    assert hists and sum(hists[0]["counts"]) > 0
+
+
+def test_train_dalle_sample_image_logging(workspace, trained_vae):
+    """Generated-sample logging at the sampling cadence (reference
+    train_dalle.py:639-649)."""
+    import json
+
+    train_dalle_cli.main([
+        "--vae_path", str(trained_vae),
+        "--image_text_folder", str(workspace / "data"),
+        "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "8",
+        "--text_seq_len", "16", "--num_text_tokens", "64",
+        "--epochs", "1", "--batch_size", "8",
+        "--save_every_n_steps", "0",
+        "--sample_every_n_steps", "2",
+        "--dalle_output_file_name", str(workspace / "dalle_sampled"),
+        "--truncate_captions",
+    ])
+    img_dir = workspace / "dalle_sampled.images"
+    assert (img_dir / "step2_image.png").exists()
+    records = [json.loads(l) for l in open(workspace / "dalle_sampled.metrics.jsonl")]
+    caps = [r for r in records if "image_caption" in r]
+    assert caps and isinstance(caps[0]["image_caption"], str)
