@@ -8,7 +8,14 @@ softmax: memory per device is O(n/P), communication overlaps with the block
 matmuls, and the collectives ride ICI neighbour links.
 
 The math is the standard blockwise-softmax recurrence (m, l, acc carried per
-query), computed in f32 regardless of input dtype."""
+query), computed in f32 regardless of input dtype.
+
+Training memory is ALSO O(n/P): a custom VJP re-rotates blocks through the
+ring in the backward pass (flash-style recompute from the saved per-query
+logsumexp), so no step's (n_loc x n_loc) score block is ever saved.  The
+backward ring rotates a (q, do, lse, delta, dq) packet while each device's
+K/V stay put — dk/dv accumulate locally, and each packet arrives back home
+after a full cycle carrying its finished dq."""
 from __future__ import annotations
 
 from functools import partial
@@ -23,9 +30,17 @@ P = PartitionSpec
 _NEG = -1e30
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
-    """q, k, v: (b, h, n_loc, d) — the local sequence shard.  Runs the full
-    ring inside shard_map."""
+def _causal_block_mask(s, my, src, n):
+    """Mask scores for query block owned by `my` against key block owned by
+    `src` (global positions owner*n + local index)."""
+    i_loc = jnp.arange(n)
+    q_pos = my * n + i_loc[:, None]
+    k_pos = src * n + i_loc[None, :]
+    return jnp.where(k_pos <= q_pos, s, _NEG)
+
+
+def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Online-softmax ring.  Returns (out, lse) with lse: (b, h, n, 1)."""
     n_dev = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, n, d = q.shape
@@ -35,7 +50,6 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
     l = jnp.zeros((b, h, n, 1), jnp.float32)
     acc = jnp.zeros((b, h, n, d), jnp.float32)
 
-    i_loc = jnp.arange(n)
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
     k_cur, v_cur = k, v
@@ -43,9 +57,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
         src = jnp.mod(my - step, n_dev)  # device whose block we currently hold
         s = jnp.einsum("bhid,bhjd->bhij", q32, k_cur.astype(jnp.float32))
         if causal:
-            q_pos = my * n + i_loc[:, None]
-            k_pos = src * n + i_loc[None, :]
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
+            s = _causal_block_mask(s, my, src, n)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p_exp = jnp.exp(s - m_new)
@@ -56,8 +68,74 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
 
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l
+    lse = m + jnp.log(l)
+    return out.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    """q, k, v: (b, h, n_loc, d) — the local sequence shard.  Runs the full
+    ring inside shard_map."""
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, res, do):
+    """Ring-recompute backward: probabilities are rebuilt per block from the
+    saved logsumexp (never materialized across steps), K/V never move — the
+    (q, do, lse, delta, dq) packet rotates instead and is home after n_dev
+    hops with its dq complete."""
+    q, k, v, out, lse = res
+    n_dev = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    n = q.shape[2]
+
+    f32 = jnp.float32
+    k32 = k.astype(f32)
+    v32 = v.astype(f32)
+    delta = jnp.sum(do.astype(f32) * out.astype(f32), axis=-1, keepdims=True)
+
+    dk = jnp.zeros_like(k32)
+    dv = jnp.zeros_like(v32)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    # the rotating packet; q/do ride the ring in their input dtype (like the
+    # forward's k/v — half the ICI bytes under bf16) and are cast per step;
+    # lse/delta/dq genuinely need f32.  q stays raw (scale enters via ds,
+    # matching s = (q*scale)·k so dq = scale * ds·k and dk = scale * ds^T·q)
+    packet = (q, do, lse, delta, jnp.zeros(q.shape, f32))
+    for step in range(n_dev):
+        q_raw, do_raw, lse_cur, delta_cur, dq_cur = packet
+        q_cur = q_raw.astype(f32)
+        do_cur = do_raw.astype(f32)
+        owner = jnp.mod(my - step, n_dev)  # whose queries we currently hold
+        s = jnp.einsum("bhid,bhjd->bhij", q_cur * scale, k32)
+        if causal:
+            s = _causal_block_mask(s, owner, my, n)
+        p = jnp.exp(s - lse_cur)  # masked entries: exp(_NEG - lse) == 0
+        dp = jnp.einsum("bhid,bhjd->bhij", do_cur, v32)
+        ds = p * (dp - delta_cur)
+        dq_cur = dq_cur + jnp.einsum("bhij,bhjd->bhid", ds, k32) * scale
+        dk = dk + jnp.einsum("bhij,bhid->bhjd", ds, q_cur) * scale
+        dv = dv + jnp.einsum("bhij,bhid->bhjd", p, do_cur)
+        # rotate after EVERY step (incl. the last) so each packet ends at its
+        # owner with dq finished
+        packet = jax.lax.ppermute(
+            (q_raw, do_raw, lse_cur, delta_cur, dq_cur), axis_name, perm
+        )
+
+    dq = packet[4]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention(
