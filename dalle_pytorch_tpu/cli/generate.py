@@ -59,22 +59,30 @@ def main(argv=None):
     path = Path(args.dalle_path)
     assert path.exists(), f"trained DALL-E {path} does not exist"
 
-    trees, meta = load_checkpoint(str(path))
-    if meta.get("version") != __version__:
-        print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
-
-    hparams = dict(meta["hparams"])
-    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
-        if hparams.get(k) is not None:
-            hparams[k] = tuple(hparams[k])
-    dalle_cfg = DALLEConfig(**hparams)
-    # reference generate.py:94-101: reconstitute whichever VAE class the
-    # checkpoint was trained with
-    vae_cfg = vae_registry.config_from_meta(
-        meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
+    from dalle_pytorch_tpu.models.torch_port import (
+        is_torch_checkpoint,
+        load_reference_dalle_checkpoint,
     )
-    params = trees["weights"]
-    vae_params = trees["vae_weights"]
+
+    if is_torch_checkpoint(str(path)):
+        # a dalle.pt trained with the torch reference — convert on load
+        ref = load_reference_dalle_checkpoint(str(path))
+        dalle_cfg, params = ref["config"], ref["params"]
+        vae_cfg, vae_params = ref["vae_config"], ref["vae_params"]
+        print(f"loaded reference-format checkpoint (version {ref.get('version')})")
+    else:
+        trees, meta = load_checkpoint(str(path))
+        if meta.get("version") != __version__:
+            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
+
+        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
+        # reference generate.py:94-101: reconstitute whichever VAE class the
+        # checkpoint was trained with
+        vae_cfg = vae_registry.config_from_meta(
+            meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
+        )
+        params = trees["weights"]
+        vae_params = trees["vae_weights"]
 
     tokenizer = get_tokenizer(args)
     from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch

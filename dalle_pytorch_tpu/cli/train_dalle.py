@@ -124,6 +124,14 @@ def reconstitute_vae(args, resume=None):
         )
         return trees["vae_weights"], cfg
     if args.vae_path is not None:
+        from dalle_pytorch_tpu.models.torch_port import (
+            is_torch_checkpoint,
+            load_reference_vae_checkpoint,
+        )
+
+        if is_torch_checkpoint(args.vae_path):
+            # a vae.pt trained with the torch reference — convert on load
+            return load_reference_vae_checkpoint(args.vae_path)
         trees, meta = load_checkpoint(args.vae_path)
         return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
     if (args.vqgan_model_path or args.vqgan_config_path) and not args.taming:
@@ -174,13 +182,42 @@ def main(argv=None):
     is_root = be.is_root_worker()
 
     tokenizer = get_tokenizer(args)
-    resume = load_checkpoint(args.dalle_path) if args.dalle_path is not None else None
-    vae_params, vae_cfg = reconstitute_vae(args, resume)
+
+    ref_resume = None
+    if args.dalle_path is not None:
+        from dalle_pytorch_tpu.models.torch_port import (
+            is_torch_checkpoint,
+            load_reference_dalle_checkpoint,
+        )
+
+        if is_torch_checkpoint(args.dalle_path):
+            # a dalle.pt trained with the torch reference: convert the model
+            # + embedded VAE and continue training (optimizer starts fresh —
+            # torch Adam state is not portable)
+            ref_resume = load_reference_dalle_checkpoint(args.dalle_path)
+            if is_root:
+                print(f"resuming from reference checkpoint {args.dalle_path} "
+                      f"(epoch {ref_resume['epoch']}, fresh optimizer state)")
+    resume = (
+        load_checkpoint(args.dalle_path)
+        if args.dalle_path is not None and ref_resume is None
+        else None
+    )
+
+    if ref_resume is not None:
+        vae_params, vae_cfg = ref_resume["vae_params"], ref_resume["vae_config"]
+    else:
+        vae_params, vae_cfg = reconstitute_vae(args, resume)
 
     resume_meta = None
-    if resume is not None:
+    if ref_resume is not None:
+        dalle_cfg = ref_resume["config"]
+        start_params = ref_resume["params"]
+        resume_meta = {"epoch": ref_resume["epoch"]}
+        trees = {}
+    elif resume is not None:
         trees, resume_meta = resume
-        dalle_cfg = DALLEConfig(**_tupled(resume_meta["hparams"]))
+        dalle_cfg = DALLEConfig.from_dict(resume_meta["hparams"])
         start_params = trees["weights"]
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
@@ -357,14 +394,6 @@ def _parse_ids(s):
     if s is None:
         return None
     return tuple(int(x) for x in s.split(","))
-
-
-def _tupled(hparams: dict) -> dict:
-    out = dict(hparams)
-    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
-        if out.get(k) is not None:
-            out[k] = tuple(out[k])
-    return out
 
 
 if __name__ == "__main__":

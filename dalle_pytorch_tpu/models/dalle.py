@@ -112,11 +112,26 @@ class DALLEConfig:
         return dataclasses.asdict(self)
 
     @classmethod
+    def from_dict(cls, hparams: dict) -> "DALLEConfig":
+        """Rebuild from a serialized to_dict (tuple fields round-trip json as
+        lists)."""
+        return cls(**tupled_hparams(hparams))
+
+    @classmethod
     def from_vae(cls, vae_cfg, **kwargs) -> "DALLEConfig":
         """Derive the image-side fields from a DiscreteVAEConfig (or any object
         with num_tokens / image_size / num_layers)."""
         fmap = vae_cfg.image_size // (2 ** vae_cfg.num_layers)
         return cls(num_image_tokens=vae_cfg.num_tokens, image_fmap_size=fmap, **kwargs)
+
+
+def tupled_hparams(hparams: dict) -> dict:
+    """Coerce the tuple-typed config keys back from json-round-tripped lists."""
+    out = dict(hparams)
+    for k in ("attn_types", "shared_attn_ids", "shared_ff_ids"):
+        if out.get(k) is not None:
+            out[k] = tuple(out[k])
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -197,3 +197,115 @@ def convert_dalle_state_dict(state: Dict, cfg: DALLEConfig) -> dict:
             f"converted pytree structure mismatch:\n got {got_struct}\nwant {ref_struct}"
         )
     return params
+
+
+# ---------------------------------------------------------------------------
+# whole-checkpoint interop: load reference-trained .pt files directly
+# ---------------------------------------------------------------------------
+
+def is_torch_checkpoint(path: str) -> bool:
+    """True for torch-format save files (zip with a data.pkl member or legacy
+    pickle) — as opposed to this framework's npz checkpoints."""
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+        return any(n.endswith("data.pkl") for n in names)  # torch zip format
+    except zipfile.BadZipFile:
+        # legacy torch saves are raw pickles; this framework's npz is a zip
+        with open(path, "rb") as f:
+            return f.read(1) == b"\x80"
+
+
+def _filter_kwargs(cls, kwargs: Dict) -> Dict:
+    import dataclasses
+
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in kwargs.items() if k in names}
+
+
+def load_reference_vae_checkpoint(path: str):
+    """Reference `train_vae.py` checkpoint ({'hparams', 'weights'} torch save,
+    train_vae.py:203-223) -> (params pytree, DiscreteVAEConfig)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    cfg = DiscreteVAEConfig(**_filter_kwargs(DiscreteVAEConfig, dict(obj["hparams"])))
+    params = convert_discrete_vae_state_dict(obj["weights"], cfg)
+    return params, cfg
+
+
+def dalle_config_from_reference_hparams(hparams: Dict, vae_cfg) -> DALLEConfig:
+    """Reference `dalle_params` dict (train_dalle.py:295-313) -> DALLEConfig,
+    with the image side derived from the VAE exactly as the reference's DALLE
+    constructor does (dalle_pytorch.py:381-384)."""
+    from dalle_pytorch_tpu.models.dalle import tupled_hparams
+
+    hp = tupled_hparams(hparams)
+    if hp.get("attn_types") is None:
+        hp["attn_types"] = ("full",)
+    hp = _filter_kwargs(DALLEConfig, hp)
+    hp.pop("num_image_tokens", None)
+    hp.pop("image_fmap_size", None)
+    return DALLEConfig.from_vae(vae_cfg, **hp)
+
+
+def load_reference_dalle_checkpoint(path: str):
+    """Reference `train_dalle.py` checkpoint ({'hparams', 'vae_params',
+    'vae_class_name', 'weights', ...}, train_dalle.py:535-582) -> dict with
+    the DALLE pytree/config and the embedded frozen VAE (the reference stores
+    it inside the DALLE state dict under 'vae.*').
+
+    Supported vae_class_name values: DiscreteVAE (config from 'vae_params')
+    and OpenAIDiscreteVAE (static config).  VQGanVAE checkpoints don't carry
+    the taming ddconfig, so they need the original yaml — raise with that
+    guidance."""
+    import torch
+
+    from dalle_pytorch_tpu.models import openai_vae as openai_mod
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    state = obj["weights"]
+    if isinstance(state, str):
+        raise ValueError(
+            "this reference checkpoint is a DeepSpeed auxiliary file without "
+            "consolidated weights; consolidate it with the reference tooling first"
+        )
+    vae_state = {k[len("vae."):]: v for k, v in state.items() if k.startswith("vae.")}
+    dalle_state = {k: v for k, v in state.items() if not k.startswith("vae.")}
+
+    class_name = obj.get("vae_class_name")
+    if class_name is None:
+        # pre-'vae_class_name' reference releases: dispatch the way the old
+        # reference generate.py did — a DiscreteVAE iff vae_params was saved
+        class_name = "DiscreteVAE" if obj.get("vae_params") else "OpenAIDiscreteVAE"
+    if class_name == "DiscreteVAE":
+        vae_cfg = DiscreteVAEConfig(
+            **_filter_kwargs(DiscreteVAEConfig, dict(obj["vae_params"] or {}))
+        )
+        vae_params = convert_discrete_vae_state_dict(vae_state, vae_cfg)
+    elif class_name == "OpenAIDiscreteVAE":
+        vae_cfg = openai_mod.OpenAIVAEConfig()
+        enc = {k[len("enc."):]: v for k, v in vae_state.items() if k.startswith("enc.")}
+        dec = {k[len("dec."):]: v for k, v in vae_state.items() if k.startswith("dec.")}
+        vae_params = openai_mod.convert_openai_state_dicts(enc, dec)
+    else:
+        raise ValueError(
+            f"reference checkpoint uses {class_name}, whose taming config is "
+            "not stored in the checkpoint.  Load the original VQGAN yourself "
+            "(api.VQGanVAE / models.pretrained.load_vqgan_pretrained with the "
+            "original checkpoint + yaml) and convert the DALLE weights via "
+            "convert_dalle_state_dict"
+        )
+
+    cfg = dalle_config_from_reference_hparams(obj["hparams"], vae_cfg)
+    params = convert_dalle_state_dict(dalle_state, cfg)
+    return {
+        "params": params,
+        "config": cfg,
+        "vae_params": vae_params,
+        "vae_config": vae_cfg,
+        "epoch": obj.get("epoch", 0),
+        "version": obj.get("version"),
+    }

@@ -393,3 +393,69 @@ def test_dalle_greedy_sampling_parity(ref_models):
     np.testing.assert_allclose(
         ours_imgs, np.transpose(ref_imgs, (0, 2, 3, 1)), atol=1e-3, rtol=1e-3
     )
+
+
+# ---------------------------------------------------------------------------
+# whole-checkpoint interop: reference-trained .pt files drive our CLIs
+# ---------------------------------------------------------------------------
+
+
+def test_train_dalle_on_reference_vae_checkpoint(ref_models, tmp_path):
+    """A vae.pt produced by the reference's train_vae.py save format
+    (train_vae.py:203-223) trains a DALL-E through our CLI directly."""
+    import torch
+    from test_cli import make_rainbow_dataset
+
+    from dalle_pytorch_tpu.cli import train_dalle as train_dalle_cli
+
+    ref_vae, _, _ = _make_vae_pair(ref_models)
+    vae_pt = tmp_path / "ref_vae.pt"
+    torch.save({"hparams": dict(_VAE_GEOM), "weights": ref_vae.state_dict()}, str(vae_pt))
+
+    make_rainbow_dataset(tmp_path / "data", n=16, size=_VAE_GEOM["image_size"])
+    state, cfg = train_dalle_cli.main([
+        "--vae_path", str(vae_pt),
+        "--image_text_folder", str(tmp_path / "data"),
+        "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "8",
+        "--text_seq_len", "16", "--num_text_tokens", "64",
+        "--epochs", "1", "--batch_size", "8",
+        "--save_every_n_steps", "0", "--sample_every_n_steps", "0",
+        "--dalle_output_file_name", str(tmp_path / "dalle_from_ref_vae"),
+        "--truncate_captions",
+    ])
+    assert cfg.num_image_tokens == _VAE_GEOM["num_tokens"]
+    assert (tmp_path / "dalle_from_ref_vae.pt").exists()
+
+
+def test_generate_from_reference_dalle_checkpoint(ref_models, tmp_path):
+    """A dalle.pt in the reference's checkpoint format (train_dalle.py:535-582,
+    weights include the embedded frozen VAE under 'vae.*') generates through
+    our CLI directly."""
+    import torch
+
+    from dalle_pytorch_tpu.cli import generate as generate_cli
+
+    ref_dalle, cfg, _, _ = _make_dalle_pair(ref_models)
+    dalle_pt = tmp_path / "ref_dalle.pt"
+    hparams = {
+        "num_text_tokens": cfg.num_text_tokens, "text_seq_len": cfg.text_seq_len,
+        "dim": cfg.dim, "depth": cfg.depth, "heads": cfg.heads,
+        "dim_head": cfg.dim_head, "reversible": False, "loss_img_weight": 7,
+        "attn_types": list(cfg.attn_types), "ff_dropout": 0.0, "attn_dropout": 0.0,
+        "stable": cfg.stable, "shift_tokens": cfg.shift_tokens,
+        "rotary_emb": cfg.rotary_emb, "shared_attn_ids": None,
+        "shared_ff_ids": None, "share_input_output_emb": False,
+    }
+    torch.save({
+        "hparams": hparams, "vae_params": dict(_VAE_GEOM), "epoch": 3,
+        "version": "1.6.6", "vae_class_name": "DiscreteVAE",
+        "weights": ref_dalle.state_dict(),
+    }, str(dalle_pt))
+
+    paths = generate_cli.main([
+        "--dalle_path", str(dalle_pt),
+        "--text", "a red circle",
+        "--num_images", "1", "--batch_size", "1",
+        "--outputs_dir", str(tmp_path / "outputs"),
+    ])
+    assert len(paths) == 1
