@@ -214,16 +214,16 @@ def load_openai_vae(encoder_path: str, decoder_path: str) -> Dict:
 
 def init_random_like(key: jax.Array) -> Dict:
     """Randomly-initialized params with the exact OpenAI dVAE layout (used by
-    tests and for offline smoke runs; real use converts published weights)."""
-    from dalle_pytorch_tpu.core.rng import KeyChain
-
-    keys = KeyChain(key)
+    tests and for offline smoke runs; real use converts published weights).
+    numpy RNG — the ~100M fixed-size parameters take ~50s through per-conv
+    jax.random on CPU and well under a second this way."""
+    rng = np.random.RandomState(int(jax.random.randint(key, (), 0, 2 ** 31 - 1)))
 
     def conv(kh, cin, cout):
         fan = kh * kh * cin
         bound = 1.0 / math.sqrt(fan)
         return {
-            "w": jax.random.uniform(keys.next(), (kh, kh, cin, cout), jnp.float32, -bound, bound),
+            "w": jnp.asarray(rng.uniform(-bound, bound, (kh, kh, cin, cout)).astype(np.float32)),
             "b": jnp.zeros((cout,), jnp.float32),
         }
 

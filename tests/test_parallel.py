@@ -265,21 +265,21 @@ def test_ring_attention_grads_match_dense_8dev():
     packet rotates, K/V stay local, probabilities rebuilt from the saved
     logsumexp) must match dense gradients at a full 8-device ring."""
     mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=8))
-    b, h, n, d = 2, 2, 64, 16
+    b, h, n, d = 1, 2, 32, 16
     q, k, v = (
         jax.random.normal(jax.random.PRNGKey(10 + i), (b, h, n, d), jnp.float32)
         for i in range(3)
     )
 
-    for causal in (True, False):
-        def loss_ring(q, k, v):
-            return jnp.sum(ring_attention(q, k, v, mesh, causal=causal) ** 2)
+    # causal only: the non-causal backward is the same code minus the block
+    # mask, and sp=4 non-causal is covered by test_ring_attention_non_causal
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
 
-        def loss_dense(q, k, v):
-            mask = causal_mask(n) if causal else None
-            return jnp.sum(attend(q * d ** -0.5, k, v, mask=mask) ** 2)
+    def loss_dense(q, k, v):
+        return jnp.sum(attend(q * d ** -0.5, k, v, mask=causal_mask(n)) ** 2)
 
-        g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
-        g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-        for a, b_ in zip(g_r, g_d):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)
+    g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_r, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)

@@ -33,12 +33,18 @@ def setup(cfg, seed=0):
 
 def greedy_oracle(params, cfg, text):
     """Uncached full-forward greedy decoding, the reference's loop structure
-    (dalle_pytorch.py:539-551) with argmax sampling."""
+    (dalle_pytorch.py:539-551) with argmax sampling.  Each prefix length jits
+    its own small forward — eager execution of the loop costs ~10x more."""
     b = text.shape[0]
+
+    @jax.jit
+    def next_code(params, text, codes):
+        logits = dalle_mod.forward(params, cfg, text, codes if codes.shape[1] else None)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32) - cfg.num_text_tokens_padded
+
     codes = jnp.zeros((b, 0), jnp.int32)
-    for i in range(cfg.image_seq_len):
-        logits = dalle_mod.forward(params, cfg, text, codes if i > 0 else None)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32) - cfg.num_text_tokens_padded
+    for _ in range(cfg.image_seq_len):
+        nxt = next_code(params, text, codes)
         codes = jnp.concatenate([codes, nxt[:, None]], axis=1)
     return np.asarray(codes)
 
