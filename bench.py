@@ -55,7 +55,7 @@ def main():
         # largest headline-shaped config that trains on one chip with good MXU
         # shapes: DALL-E width (dim 2048 — K=2048 matmuls run ~2x the TFLOP/s
         # of K=1024 on v5e), seq 1280, ~610M params + f32 adam.  Microbatch 8
-        # (the best single-chip shape) with 4-step gradient accumulation —
+        # (the best single-chip shape) with 8-step gradient accumulation —
         # a real large-scale training configuration (the reference's
         # --ga_steps) that amortizes the Adam update across microbatches.
         cfg = DALLEConfig(
@@ -66,8 +66,8 @@ def main():
             shift_tokens=True, rotary_emb=True, execution="sequential",
             share_input_output_emb=True,
         )
-        batch, grad_accum = 32, 4
-        steps, warmup = 6, 2
+        batch, grad_accum = 64, 8
+        steps, warmup = 4, 2
     else:  # CPU smoke fallback
         cfg = DALLEConfig(
             dim=128, depth=2, heads=4, dim_head=32,

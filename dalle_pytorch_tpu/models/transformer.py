@@ -66,11 +66,14 @@ class TransformerConfig:
     # near-constant compile time in depth (essential for depth-64 configs).
     # Requires unshared layers; composes with execution='remat'.
     scan_layers: bool = False
-    attn_kernel: str = "auto"  # 'auto' | 'flash' (Pallas) | 'xla' (dense masked)
+    # 'auto' | 'flash' (Pallas) | 'xla' (dense masked) | 'ring' (explicit
+    # ring attention over seq_shard_axis — full-attention layers only)
+    attn_kernel: str = "auto"
     # sequence parallelism: shard activations' sequence dim over this mesh
-    # axis between layers (GSPMD inserts the attention collectives); the
-    # explicit ring-attention kernel (parallel/ring.py) is the hand-tuned
-    # alternative for very long sequences
+    # axis between layers.  GSPMD inserts the attention collectives by
+    # default; attn_kernel='ring' instead runs the explicit ppermute ring
+    # (parallel/ring.py, O(n/P) memory fwd AND bwd) for 'full' layers —
+    # the hand-tuned path for very long sequences
     seq_shard_axis: Optional[str] = None
     conv_kernel_size: int = 5
     conv_dilation: int = 1
@@ -209,7 +212,7 @@ def _merge_heads(x):
 
 
 def _use_flash(cfg, n: int, key_mask) -> bool:
-    if cfg.attn_kernel == "xla" or key_mask is not None:
+    if cfg.attn_kernel in ("xla", "ring") or key_mask is not None:
         return False
     if cfg.seq_shard_axis is not None:
         return False  # GSPMD partitions the XLA attention; pallas_call can't split seq
@@ -220,6 +223,24 @@ def _use_flash(cfg, n: int, key_mask) -> bool:
     return jax.default_backend() == "tpu"  # 'auto'
 
 
+def _ambient_mesh():
+    """The physical mesh installed by the enclosing `with mesh:` block (the
+    train step enters it), or None outside one."""
+    from jax.interpreters import pxla
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    return None if mesh.empty else mesh
+
+
+def _use_ring(cfg, pattern, key_mask) -> bool:
+    return (
+        cfg.attn_kernel == "ring"
+        and cfg.seq_shard_axis is not None
+        and pattern is None  # ring path is for 'full' layers; patterned
+        and key_mask is None  # layers fall back to the GSPMD dense path
+    )
+
+
 def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
     b, n, _ = x.shape
     qkv = linear(shared["qkv"], x)
@@ -228,6 +249,18 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
     if rotary is not None:
         ang = rotary[:n]
         q, k, v = (apply_rotary(ang, t) for t in (q, k, v))
+
+    if _use_ring(cfg, pattern, key_mask):
+        mesh = _ambient_mesh()
+        if mesh is not None:
+            from dalle_pytorch_tpu.parallel.ring import ring_attention
+
+            out = ring_attention(
+                q, k, v, mesh, causal=cfg.causal,
+                axis_name=cfg.seq_shard_axis, scale=cfg.dim_head ** -0.5,
+            )
+            out = linear(shared["out"], _merge_heads(out))
+            return apply_dropout(dkey, out, cfg.attn_dropout)
 
     if _use_flash(cfg, n, key_mask):
         from dalle_pytorch_tpu.kernels.flash_attention import flash_attention
