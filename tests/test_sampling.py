@@ -49,7 +49,18 @@ def greedy_oracle(params, cfg, text):
     return np.asarray(codes)
 
 
-@pytest.mark.parametrize("kw", [dict(), dict(attn_types=("axial_row", "conv_like")), dict(execution="reversible")])
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(),
+        dict(attn_types=("axial_row", "conv_like")),
+        dict(execution="reversible"),
+        # asymmetric geometry: the logits-mask row is selected by the
+        # PRODUCING position (dalle_pytorch.py:646-652); a text/image length
+        # imbalance catches off-by-one row selection the square case hides
+        dict(text_seq_len=12, image_fmap_size=3, num_image_tokens=24),
+    ],
+)
 def test_greedy_sampling_matches_uncached_oracle(kw):
     cfg = tiny_cfg(**kw)
     params, text = setup(cfg)
