@@ -31,6 +31,14 @@ def build_parser():
     parser.add_argument("--cond_scale", type=float, default=1.0, help="classifier-free guidance scale")
     parser.add_argument("--outputs_dir", type=str, default="./outputs")
     parser.add_argument("--gentxt", action="store_true", help="complete the prompt with DALL-E first")
+    parser.add_argument("--taming", action="store_true",
+                        help="the checkpoint's VAE is a taming VQGAN (reference-format "
+                             "checkpoints need its yaml via --vqgan_config_path)")
+    parser.add_argument("--vqgan_config_path", type=str, default=None,
+                        help="taming config yaml for a reference VQGanVAE checkpoint")
+    parser.add_argument("--vqgan_model_path", type=str, default=None,
+                        help="unused for conversion (weights are embedded in the "
+                             "checkpoint); accepted for reference CLI parity")
     parser.add_argument("--chinese", action="store_true")
     parser.add_argument("--hug", action="store_true")
     parser.add_argument("--bpe_path", type=str, default=None)
@@ -66,7 +74,12 @@ def main(argv=None):
 
     if is_torch_checkpoint(str(path)):
         # a dalle.pt trained with the torch reference — convert on load
-        ref = load_reference_dalle_checkpoint(str(path))
+        taming_config = None
+        if args.vqgan_config_path:  # --taming is implied by the config path
+            from dalle_pytorch_tpu.models.pretrained import parse_taming_yaml
+
+            taming_config = parse_taming_yaml(args.vqgan_config_path)
+        ref = load_reference_dalle_checkpoint(str(path), taming_config=taming_config)
         dalle_cfg, params = ref["config"], ref["params"]
         vae_cfg, vae_params = ref["vae_config"], ref["vae_params"]
         print(f"loaded reference-format checkpoint (version {ref.get('version')})")

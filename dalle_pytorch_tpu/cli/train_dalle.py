@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--bpe_path", type=str, default=None)
     parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
     parser.add_argument("--bf16", action="store_true", help="bf16 compute (TPU-native mixed precision)")
+    parser.add_argument("--fp16", action="store_true",
+                        help="reference-compat alias: mapped to bf16 (no loss scaling needed on TPU)")
+    parser.add_argument("--amp", action="store_true",
+                        help="reference-compat alias: mapped to bf16")
     parser.add_argument("--wandb", action="store_true")
     parser.add_argument("--wandb_name", type=str, default="dalle_train_transformer")
     parser.add_argument("--wandb_entity", type=str, default=None)
@@ -63,6 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--heads", type=int, default=8)
     parser.add_argument("--dim_head", type=int, default=64)
     parser.add_argument("--reversible", action="store_true")
+    parser.add_argument("--attn_dropout", type=float, default=0.0)
+    parser.add_argument("--ff_dropout", type=float, default=0.0)
     parser.add_argument("--execution", type=str, default=None, choices=[None, "sequential", "remat", "reversible"])
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--attn_types", type=str, default="full",
@@ -193,8 +199,16 @@ def main(argv=None):
         if is_torch_checkpoint(args.dalle_path):
             # a dalle.pt trained with the torch reference: convert the model
             # + embedded VAE and continue training (optimizer starts fresh —
-            # torch Adam state is not portable)
-            ref_resume = load_reference_dalle_checkpoint(args.dalle_path)
+            # torch Adam state is not portable).  VQGanVAE-class checkpoints
+            # need their taming yaml (--vqgan_config_path)
+            taming_config = None
+            if args.vqgan_config_path:
+                from dalle_pytorch_tpu.models.pretrained import parse_taming_yaml
+
+                taming_config = parse_taming_yaml(args.vqgan_config_path)
+            ref_resume = load_reference_dalle_checkpoint(
+                args.dalle_path, taming_config=taming_config
+            )
             if is_root:
                 print(f"resuming from reference checkpoint {args.dalle_path} "
                       f"(epoch {ref_resume['epoch']}, fresh optimizer state)")
@@ -230,6 +244,8 @@ def main(argv=None):
             heads=args.heads,
             dim_head=args.dim_head,
             reversible=args.reversible,
+            attn_dropout=args.attn_dropout,
+            ff_dropout=args.ff_dropout,
             execution=args.execution,
             loss_img_weight=args.loss_img_weight,
             attn_types=tuple(args.attn_types.split(",")),
@@ -296,9 +312,12 @@ def main(argv=None):
                 factor=0.5, patience=10, cooldown=10, min_scale=1e-6 / args.learning_rate
             ),
         )
+    use_bf16 = args.bf16 or args.fp16 or args.amp
+    if (args.fp16 or args.amp) and is_root:
+        print("note: --fp16/--amp map to bf16 on TPU (no loss scaling needed)")
     settings = StepSettings(
         grad_accum=args.ga_steps,
-        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        compute_dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
         clip_grad_norm=args.clip_grad_norm,
         zero_stage=args.zero_stage,
     )

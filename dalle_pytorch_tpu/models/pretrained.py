@@ -25,6 +25,18 @@ VQGAN_FILENAME = "vqgan.1024.model.ckpt"
 VQGAN_CONFIG_FILENAME = "vqgan.1024.config.yml"
 
 
+def parse_taming_yaml(path: str) -> dict:
+    """Parsed taming config yaml, unwrapped to its 'model' section when the
+    file is a full experiment config."""
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f)
+    if isinstance(config, dict) and "model" in config:
+        config = config["model"]
+    return config
+
+
 def default_cache_dir() -> Path:
     return Path(
         os.environ.get(
@@ -154,22 +166,13 @@ def load_vqgan_pretrained(
     root = Path(cache_dir or default_cache_dir())
     backend = backend if backend is not None else _current_backend()
 
-    def parse_config(path: str) -> dict:
-        import yaml
-
-        with open(path) as f:
-            config = yaml.safe_load(f)
-        if isinstance(config, dict) and "model" in config:
-            config = config["model"]
-        return config
-
     if model_path is not None:
         if config_path is None:
             # silently assuming the published f16/1024 geometry for a custom
             # checkpoint would mis-convert it (same contract as the
             # reference's VQGanVAE assert, vae.py:164)
             raise ValueError("a custom vqgan_model_path requires its vqgan_config_path")
-        return load_vqgan(model_path, parse_config(config_path))
+        return load_vqgan(model_path, parse_taming_yaml(config_path))
 
     # published default: coordinated download + convert-once (later runs and
     # non-root ranks load the pytree with no torch in the loop)
@@ -179,7 +182,7 @@ def load_vqgan_pretrained(
     )
 
     def convert():
-        params, cfg = load_vqgan(str(ckpt), parse_config(str(cfg_file)))
+        params, cfg = load_vqgan(str(ckpt), parse_taming_yaml(str(cfg_file)))
         return {"params": params}, {"vqgan_config": cfg.to_dict()}
 
     trees, meta = _convert_once(root / "vqgan_default_converted.npz", backend, convert)

@@ -251,16 +251,16 @@ def dalle_config_from_reference_hparams(hparams: Dict, vae_cfg) -> DALLEConfig:
     return DALLEConfig.from_vae(vae_cfg, **hp)
 
 
-def load_reference_dalle_checkpoint(path: str):
+def load_reference_dalle_checkpoint(path: str, taming_config: Optional[Dict] = None):
     """Reference `train_dalle.py` checkpoint ({'hparams', 'vae_params',
     'vae_class_name', 'weights', ...}, train_dalle.py:535-582) -> dict with
     the DALLE pytree/config and the embedded frozen VAE (the reference stores
     it inside the DALLE state dict under 'vae.*').
 
-    Supported vae_class_name values: DiscreteVAE (config from 'vae_params')
-    and OpenAIDiscreteVAE (static config).  VQGanVAE checkpoints don't carry
-    the taming ddconfig, so they need the original yaml — raise with that
-    guidance."""
+    Supported vae_class_name values: DiscreteVAE (config from 'vae_params'),
+    OpenAIDiscreteVAE (static config), and VQGanVAE when `taming_config` (the
+    parsed taming yaml, which the checkpoint itself doesn't carry) is
+    supplied — its weights convert from the embedded 'vae.model.*' entries."""
     import torch
 
     from dalle_pytorch_tpu.models import openai_vae as openai_mod
@@ -290,13 +290,24 @@ def load_reference_dalle_checkpoint(path: str):
         enc = {k[len("enc."):]: v for k, v in vae_state.items() if k.startswith("enc.")}
         dec = {k[len("dec."):]: v for k, v in vae_state.items() if k.startswith("dec.")}
         vae_params = openai_mod.convert_openai_state_dicts(enc, dec)
+    elif class_name == "VQGanVAE" and taming_config is not None:
+        from dalle_pytorch_tpu.models.vqgan import (
+            config_from_taming_dict,
+            convert_taming_state_dict,
+        )
+
+        # the reference VQGanVAE wrapper holds the taming model at self.model
+        taming_state = {
+            k[len("model."):]: v for k, v in vae_state.items() if k.startswith("model.")
+        }
+        vae_cfg = config_from_taming_dict(taming_config, taming_state)
+        vae_params = convert_taming_state_dict(taming_state, vae_cfg)
     else:
         raise ValueError(
             f"reference checkpoint uses {class_name}, whose taming config is "
-            "not stored in the checkpoint.  Load the original VQGAN yourself "
-            "(api.VQGanVAE / models.pretrained.load_vqgan_pretrained with the "
-            "original checkpoint + yaml) and convert the DALLE weights via "
-            "convert_dalle_state_dict"
+            "not stored in the checkpoint — pass the original yaml "
+            "(--vqgan_config_path on the train/generate CLIs, or the "
+            "taming_config argument here)"
         )
 
     cfg = dalle_config_from_reference_hparams(obj["hparams"], vae_cfg)

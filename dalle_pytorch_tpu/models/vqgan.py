@@ -302,6 +302,27 @@ def convert_taming_state_dict(state: Dict, cfg: VQGANConfig) -> Dict:
     return params
 
 
+def config_from_taming_dict(config: dict, state: Dict) -> VQGANConfig:
+    """VQGANConfig from a parsed taming yaml ('model' section or its
+    'params') plus the state dict (which reveals the GumbelVQ variant)."""
+    cfg_kwargs = {}
+    dd = config.get("params", config).get("ddconfig", {})
+    for k in ("ch", "num_res_blocks", "in_channels", "out_ch", "resolution", "z_channels"):
+        if k in dd:
+            cfg_kwargs[k] = dd[k]
+    if "ch_mult" in dd:
+        cfg_kwargs["ch_mult"] = tuple(dd["ch_mult"])
+    if "attn_resolutions" in dd:
+        cfg_kwargs["attn_resolutions"] = tuple(dd["attn_resolutions"])
+    params_cfg = config.get("params", config)
+    if "n_embed" in params_cfg:
+        cfg_kwargs["n_embed"] = params_cfg["n_embed"]
+    if "embed_dim" in params_cfg:
+        cfg_kwargs["embed_dim"] = params_cfg["embed_dim"]
+    cfg_kwargs["is_gumbel"] = "quantize.embed.weight" in state
+    return VQGANConfig(**cfg_kwargs)
+
+
 def load_vqgan(model_path: str, config: Optional[dict] = None) -> Tuple[Dict, VQGANConfig]:
     """Load a taming checkpoint (torch .ckpt with 'state_dict') and its
     ddconfig dict (from the matching yaml).  torch needed at load time only.
@@ -315,23 +336,7 @@ def load_vqgan(model_path: str, config: Optional[dict] = None) -> Tuple[Dict, VQ
                          "(parsed from its taming yaml)")
     ckpt = torch.load(model_path, map_location="cpu", weights_only=False)
     state = ckpt.get("state_dict", ckpt)
-    cfg_kwargs = {}
-    if config:
-        dd = config.get("params", config).get("ddconfig", {})
-        for k in ("ch", "num_res_blocks", "in_channels", "out_ch", "resolution", "z_channels"):
-            if k in dd:
-                cfg_kwargs[k] = dd[k]
-        if "ch_mult" in dd:
-            cfg_kwargs["ch_mult"] = tuple(dd["ch_mult"])
-        if "attn_resolutions" in dd:
-            cfg_kwargs["attn_resolutions"] = tuple(dd["attn_resolutions"])
-        params_cfg = config.get("params", config)
-        if "n_embed" in params_cfg:
-            cfg_kwargs["n_embed"] = params_cfg["n_embed"]
-        if "embed_dim" in params_cfg:
-            cfg_kwargs["embed_dim"] = params_cfg["embed_dim"]
-    cfg_kwargs["is_gumbel"] = "quantize.embed.weight" in state
-    cfg = VQGANConfig(**cfg_kwargs)
+    cfg = config_from_taming_dict(config, state)
     return convert_taming_state_dict(state, cfg), cfg
 
 

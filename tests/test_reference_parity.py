@@ -459,3 +459,74 @@ def test_generate_from_reference_dalle_checkpoint(ref_models, tmp_path):
         "--outputs_dir", str(tmp_path / "outputs"),
     ])
     assert len(paths) == 1
+
+
+def test_generate_from_reference_vqgan_dalle_checkpoint(ref_models, tmp_path):
+    """Reference VQGanVAE-class checkpoints carry the taming weights under
+    'vae.model.*' but not the ddconfig; generate --taming
+    --vqgan_config_path supplies the yaml and the embedded weights convert."""
+    import torch
+    import yaml
+    from taming_fixture import make_taming_state_dict
+
+    from dalle_pytorch_tpu.cli import generate as generate_cli
+    from dalle_pytorch_tpu.models.vqgan import VQGANConfig
+
+    # fmap 8 to match the VQGAN below (resolution 16, one halving)
+    ref_vae, _, _ = _make_vae_pair(ref_models, num_layers=1, num_tokens=32)
+    import torch as _t
+
+    _t.manual_seed(5)
+    ref_dalle = ref_models.DALLE(
+        vae=ref_vae, dim=48, depth=2, heads=2, dim_head=16, num_text_tokens=64,
+        text_seq_len=16, attn_types=("full",), shift_tokens=False, rotary_emb=False,
+    )
+    state = ref_dalle.state_dict()
+    state = {k: v for k, v in state.items() if not k.startswith("vae.")}
+
+    vq_cfg = VQGANConfig(
+        ch=8, ch_mult=(1, 2), num_res_blocks=1, attn_resolutions=(8,),
+        resolution=16, z_channels=8, n_embed=32, embed_dim=8,
+    )
+    for k, v in make_taming_state_dict(vq_cfg).items():
+        state[f"vae.model.{k}"] = torch.from_numpy(v)
+
+    hparams = {
+        "num_text_tokens": 64, "text_seq_len": 16, "dim": 48, "depth": 2,
+        "heads": 2, "dim_head": 16, "reversible": False, "loss_img_weight": 7,
+        "attn_types": ["full"], "ff_dropout": 0.0, "attn_dropout": 0.0,
+        "stable": False, "shift_tokens": False, "rotary_emb": False,
+        "shared_attn_ids": None, "shared_ff_ids": None,
+        "share_input_output_emb": False,
+    }
+    dalle_pt = tmp_path / "ref_vqgan_dalle.pt"
+    torch.save({
+        "hparams": hparams, "vae_params": None, "epoch": 0, "version": "1.6.6",
+        "vae_class_name": "VQGanVAE", "weights": state,
+    }, str(dalle_pt))
+
+    config_path = tmp_path / "vq.yml"
+    config_path.write_text(yaml.safe_dump({
+        "model": {"params": {
+            "n_embed": 32, "embed_dim": 8,
+            "ddconfig": {"ch": 8, "ch_mult": [1, 2], "num_res_blocks": 1,
+                         "attn_resolutions": [8], "in_channels": 3, "out_ch": 3,
+                         "resolution": 16, "z_channels": 8},
+        }},
+    }))
+
+    # without the yaml: a clear error
+    with pytest.raises(ValueError, match="taming"):
+        generate_cli.main([
+            "--dalle_path", str(dalle_pt), "--text", "a red circle",
+            "--num_images", "1", "--batch_size", "1",
+            "--outputs_dir", str(tmp_path / "nope"),
+        ])
+
+    paths = generate_cli.main([
+        "--dalle_path", str(dalle_pt), "--text", "a red circle",
+        "--taming", "--vqgan_config_path", str(config_path),
+        "--num_images", "1", "--batch_size", "1",
+        "--outputs_dir", str(tmp_path / "outputs"),
+    ])
+    assert len(paths) == 1
