@@ -384,9 +384,11 @@ def main(argv=None):
 
         if is_root:
             save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, epoch + 1)
+            logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
 
     if is_root:
         save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, args.epochs)
+        logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
     logger.finish()
     return state, dalle_cfg
 

@@ -225,10 +225,11 @@ def _use_flash(cfg, n: int, key_mask) -> bool:
 
 def _ambient_mesh():
     """The physical mesh installed by the enclosing `with mesh:` block (the
-    train step enters it), or None outside one."""
-    from jax.interpreters import pxla
+    train step enters it), or None outside one.  (jax._src.mesh is where the
+    context mesh lives; the jax.interpreters.pxla re-export is deprecated.)"""
+    from jax._src import mesh as mesh_lib
 
-    mesh = pxla.thread_resources.env.physical_mesh
+    mesh = mesh_lib.thread_resources.env.physical_mesh
     return None if mesh.empty else mesh
 
 

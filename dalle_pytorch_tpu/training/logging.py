@@ -116,6 +116,22 @@ class MetricLogger:
             parts = " ".join(f"{k}={_fmt(v)}" for k, v in metrics.items())
             print(f"[{step}] {parts}" if step is not None else parts, flush=True)
 
+    def log_artifact(self, path: str, name: str = "trained-model",
+                     metadata: Optional[dict] = None):
+        """Model-artifact logging (the reference's wandb.Artifact uploads per
+        epoch and at the end of training, train_dalle.py:584-587,667-675);
+        headless runs get the JSONL record of what was saved where."""
+        if not self.is_root:
+            return
+        if self._wandb is not None:
+            try:
+                art = self._wandb.Artifact(name, type="model", metadata=metadata or {})
+                art.add_file(path)
+                self._wandb.log_artifact(art)
+            except Exception as e:  # pragma: no cover
+                print(f"[logging] artifact upload failed ({e!r})")
+        self.log({"artifact": {"name": name, "path": str(path)}}, quiet=True)
+
     def finish(self):
         if self._file is not None:
             self._file.close()

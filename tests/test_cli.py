@@ -280,3 +280,13 @@ def test_train_dalle_sample_image_logging(workspace, trained_vae):
     records = [json.loads(l) for l in open(workspace / "dalle_sampled.metrics.jsonl")]
     caps = [r for r in records if "image_caption" in r]
     assert caps and isinstance(caps[0]["image_caption"], str)
+
+
+def test_train_dalle_artifact_records(workspace, trained_dalle):
+    """Model-artifact records at epoch end + final (reference
+    train_dalle.py:584-587,667-675; JSONL fallback when wandb is absent)."""
+    import json
+
+    records = [json.loads(l) for l in open(workspace / "dalle.metrics.jsonl")]
+    names = [r["artifact"]["name"] for r in records if "artifact" in r]
+    assert "trained-dalle" in names and "trained-dalle-final" in names
