@@ -26,8 +26,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 256  # 256x256 tiles measured ~5% faster per train step than
+DEFAULT_BLOCK_K = 256  # 128x128 at seq 1280 on v5e (block shrinks to divide n)
 _LANES = 128  # TPU lane width; lse/delta rows are stored broadcast over lanes
 _NEG = -1e30
 
@@ -382,6 +382,10 @@ def flash_attention(
         scale = d ** -0.5
     block_q = min(block_q, n)
     block_k = min(block_k, n)
+    while n % block_q:
+        block_q //= 2
+    while n % block_k:
+        block_k //= 2
 
     if mask is not None and live is None:
         try:  # static masks (the normal case) yield a tile-liveness table

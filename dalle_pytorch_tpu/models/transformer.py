@@ -491,10 +491,15 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
     )
     n = x.shape[1]
 
+    from dalle_pytorch_tpu.kernels.flash_attention import DEFAULT_BLOCK_Q
+
     distinct = list(dict.fromkeys(s.attn_type for s in specs))
     masks_np, lives_np = [], []
-    bq = min(128, n)
-    derive_live = n % bq == 0
+    # liveness granularity must match the kernel's actual block size
+    bq = min(DEFAULT_BLOCK_Q, n)
+    while n % bq:
+        bq //= 2
+    derive_live = bq >= 8
     for t in distinct:
         pm = _pattern_for(cfg, t)
         m = np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n]
