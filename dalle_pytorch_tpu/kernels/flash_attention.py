@@ -308,9 +308,10 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_
 
 def _dense_recompute_grads(q, k, v, mask, causal, scale, lse, do):
     """Backward in XLA ops with exact probabilities from the saved logsumexp.
-    Materializes (bh, n, n) transients (fused/streamed by XLA) — measured
-    faster than the two-pass Pallas backward at seq ~1280 on v5e; the Pallas
-    backward wins on memory for long sequences."""
+    Materializes (bh, n, n) transients (fused/streamed by XLA).  At 128x128
+    tiles this beat the Pallas backward at seq ~1280 on v5e; at the current
+    256x256 default the Pallas backward is both faster and O(n) memory, so
+    this path is the fallback ('xla')."""
     f32 = jnp.float32
     s = jnp.einsum("bid,bjd->bij", q.astype(f32) * scale, k.astype(f32))
     n = q.shape[1]
@@ -368,7 +369,9 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-    bwd_impl: str = "xla",  # 'xla' (fastest at seq ~1e3) | 'pallas' (O(n) memory)
+    # 'pallas' (two-pass kernels, O(n) memory — also the fastest at 256x256
+    # tiles on v5e) | 'xla' (dense recompute; was faster at 128x128 tiles)
+    bwd_impl: str = "pallas",
     live: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """(b, h, n, d) attention.  `mask`: optional static (n, n) bool pattern
