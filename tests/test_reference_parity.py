@@ -530,3 +530,47 @@ def test_generate_from_reference_vqgan_dalle_checkpoint(ref_models, tmp_path):
         "--outputs_dir", str(tmp_path / "outputs"),
     ])
     assert len(paths) == 1
+
+
+def test_dalle_stochastic_sampling_parity_fixed_noise(ref_models, monkeypatch):
+    """FULL sampling parity, not just greedy: both implementations consume the
+    same pre-generated gumbel noise sequence (the reference via a patched
+    gumbel_noise, ours via the noise_override parity hook) and must sample
+    identical token sequences — SURVEY.md section 7 hard part #1."""
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from dalle_pytorch_tpu.models import vae as vae_mod
+    from dalle_pytorch_tpu.models.sampling import sample_image_codes
+
+    ref_dalle, cfg, params, (ref_vae, vae_cfg, vae_params) = _make_dalle_pair(ref_models)
+    text, _ = _rand_batch(cfg)
+    b, n_gen = text.shape[0], cfg.image_seq_len
+
+    rng = np.random.default_rng(42)
+    u = rng.uniform(1e-6, 1.0 - 1e-6, (n_gen, b, cfg.total_tokens)).astype(np.float32)
+    noise = -np.log(-np.log(u))
+
+    step = {"i": 0}
+
+    def fixed_noise_torch(t):
+        out = torch.from_numpy(noise[step["i"]][: t.shape[0]])
+        step["i"] += 1
+        return out
+
+    monkeypatch.setattr(ref_models, "gumbel_noise", fixed_noise_torch)
+    with torch.no_grad():
+        ref_imgs = ref_dalle.generate_images(
+            torch.from_numpy(text).long(), temperature=1.0, filter_thres=0.5
+        ).numpy()
+    assert step["i"] == n_gen  # one draw per generated token
+
+    codes = sample_image_codes(
+        params, cfg, jnp.asarray(text), jax.random.PRNGKey(0),
+        temperature=1.0, filter_thres=0.5, noise_override=jnp.asarray(noise),
+    )
+    ours_imgs = np.asarray(vae_mod.decode_indices(vae_params, vae_cfg, codes))
+    np.testing.assert_allclose(
+        ours_imgs, np.transpose(ref_imgs, (0, 2, 3, 1)), atol=1e-3, rtol=1e-3
+    )
