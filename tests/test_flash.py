@@ -108,3 +108,23 @@ def test_flash_pallas_backward_with_pattern_mask():
     )(q)
     g_d = jax.grad(lambda q: jnp.sum(attend(q * d ** -0.5, k, v, mask=full) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_d), atol=5e-5)
+
+
+def test_flash_block_size_halves_to_divide_seq():
+    """Default 256 blocks shrink by halving until they divide n (e.g. n=384
+    -> 128); results must still match dense, fwd and bwd."""
+    n, d = 384, 64
+    q, k, v = qkv(n=n, d=d)
+    cm = causal_mask(n)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def f_dense(q, k, v):
+        return jnp.sum(attend(q * d ** -0.5, k, v, mask=cm) ** 2)
+
+    assert float(f_flash(q, k, v)) == pytest.approx(float(f_dense(q, k, v)), rel=1e-5)
+    g_f = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
