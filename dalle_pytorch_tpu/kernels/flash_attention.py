@@ -36,6 +36,21 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def resolve_block(n: int, block: int) -> int:
+    """The block size actually used for sequence length n: capped at n and
+    halved until it divides n.  Shared with the scan-layers path, whose
+    tile-liveness tables must be built at exactly this granularity."""
+    block = min(block, n)
+    while n % block:
+        block //= 2
+    if block < 8:  # Mosaic's minimum sublane tile; fail loudly, not in Mosaic
+        raise ValueError(
+            f"no valid flash block size for seq len {n} (power-of-2 factor too "
+            "small) — use the dense attention path"
+        )
+    return block
+
+
 def _tile_live(causal: bool, use_mask: bool, live_ref, i, j, block_q: int, block_k: int):
     live = True
     if causal:
@@ -383,12 +398,15 @@ def flash_attention(
     b, h, n, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    block_q = min(block_q, n)
-    block_k = min(block_k, n)
-    while n % block_q:
-        block_q //= 2
-    while n % block_k:
-        block_k //= 2
+    block_q = resolve_block(n, block_q)
+    block_k = resolve_block(n, block_k)
+    if live is not None:
+        # a caller-supplied liveness table must match the RESOLVED grid, not
+        # the requested blocks (silent mismatch = out-of-bounds tile skipping)
+        assert live.shape == (n // block_q, n // block_k), (
+            f"live table {live.shape} != grid {(n // block_q, n // block_k)}; "
+            f"build it at resolve_block() granularity"
+        )
 
     if mask is not None and live is None:
         try:  # static masks (the normal case) yield a tile-liveness table

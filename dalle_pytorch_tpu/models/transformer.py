@@ -491,22 +491,28 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
     )
     n = x.shape[1]
 
-    from dalle_pytorch_tpu.kernels.flash_attention import DEFAULT_BLOCK_Q
+    from dalle_pytorch_tpu.kernels.flash_attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        resolve_block,
+    )
 
     distinct = list(dict.fromkeys(s.attn_type for s in specs))
     masks_np, lives_np = [], []
-    # liveness granularity must match the kernel's actual block size
-    bq = min(DEFAULT_BLOCK_Q, n)
-    while n % bq:
-        bq //= 2
-    derive_live = bq >= 8
+    # liveness granularity must match the kernel's RESOLVED block sizes
+    try:
+        bq = resolve_block(n, DEFAULT_BLOCK_Q)
+        bk = resolve_block(n, DEFAULT_BLOCK_K)
+        derive_live = True
+    except ValueError:  # no valid block: the flash path won't be taken anyway
+        derive_live = False
     for t in distinct:
         pm = _pattern_for(cfg, t)
         m = np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n]
         masks_np.append(m)
         if derive_live:
             lives_np.append(
-                m.reshape(n // bq, bq, n // bq, bq).any(axis=(1, 3)).astype(np.int32)
+                m.reshape(n // bq, bq, n // bk, bk).any(axis=(1, 3)).astype(np.int32)
             )
     masks = jnp.asarray(np.stack(masks_np))
     lives = jnp.asarray(np.stack(lives_np)) if derive_live else None
