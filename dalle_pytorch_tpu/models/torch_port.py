@@ -22,7 +22,7 @@ our input-dilated-conv kernel = spatially flipped HWIO.
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np

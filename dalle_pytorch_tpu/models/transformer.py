@@ -253,7 +253,18 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
 
     if _use_ring(cfg, pattern, key_mask):
         mesh = _ambient_mesh()
-        if mesh is not None:
+        if mesh is None:
+            # the user explicitly asked for the ring kernel; falling back to
+            # the dense GSPMD path silently would be an O(n) memory surprise
+            import warnings
+
+            warnings.warn(
+                "attn_kernel='ring' but no mesh is installed (forward called "
+                "outside a `with mesh:` block) — falling back to dense GSPMD "
+                "attention",
+                stacklevel=2,
+            )
+        else:
             from dalle_pytorch_tpu.parallel.ring import ring_attention
 
             out = ring_attention(

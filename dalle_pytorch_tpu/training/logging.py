@@ -15,14 +15,17 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
-def make_grid(images: np.ndarray, nrow: int = 4, pad: int = 2) -> np.ndarray:
+def make_grid(images: np.ndarray, nrow: int = 4, pad: int = 2,
+              pad_value: float = 0.0) -> np.ndarray:
     """(N, H, W, C) floats in [0, 1] -> one (gh, gw, C) grid image (the
-    torchvision make_grid the reference logs, in numpy/NHWC)."""
+    torchvision make_grid the reference logs, in numpy/NHWC; padding and
+    empty trailing cells render at pad_value=0 = black, torchvision's
+    default)."""
     images = np.asarray(images)
     n, h, w, c = images.shape
     ncol = min(nrow, n)
     nr = (n + ncol - 1) // ncol
-    grid = np.ones((nr * (h + pad) + pad, ncol * (w + pad) + pad, c), images.dtype)
+    grid = np.full((nr * (h + pad) + pad, ncol * (w + pad) + pad, c), pad_value, images.dtype)
     for i in range(n):
         r, col = divmod(i, ncol)
         y, x = pad + r * (h + pad), pad + col * (w + pad)
