@@ -17,7 +17,13 @@ import numpy as np
 import optax
 
 from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
-from dalle_pytorch_tpu.data.loader import TextImageDataset, batch_tar_stream, iterate_batches, iterate_tar_shards
+from dalle_pytorch_tpu.data.loader import (
+    TextImageDataset,
+    batch_tar_stream,
+    iterate_batches,
+    iterate_tar_shards,
+    prefetch_to_device,
+)
 from dalle_pytorch_tpu.models import dalle as dalle_mod
 from dalle_pytorch_tpu.models import vae_registry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -89,6 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
     parser.add_argument("--lr_decay", action="store_true")
     parser.add_argument("--sample_every_n_steps", type=int, default=100)
+    parser.add_argument("--num_workers", type=int, default=4,
+                        help="decode/crop worker threads (0 = load in the training loop)")
+    parser.add_argument("--prefetch_batches", type=int, default=2,
+                        help="device-side prefetch depth (0 disables async transfer)")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--debug_nans", action="store_true",
                         help="abort with a traceback on the first NaN (jax_debug_nans)")
@@ -155,7 +165,8 @@ def reconstitute_vae(args, resume=None):
     return pretrained.load_openai_vae_pretrained()
 
 
-def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
+def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
+               global_step=0, wandb_run_id=None):
     class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
     save_checkpoint(
         path,
@@ -168,6 +179,8 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None):
             "hparams": dalle_cfg.to_dict(),
             "vae_params": vae_meta,
             "epoch": epoch,
+            "global_step": int(global_step),
+            "wandb_run_id": wandb_run_id,
             "version": __version__,
             "vae_class_name": class_name,
             "scheduler_state": None,
@@ -273,7 +286,7 @@ def main(argv=None):
                 shards, vae_cfg.image_size, dalle_cfg.text_seq_len, tokenizer,
                 truncate_captions=args.truncate_captions,
                 process_index=be.get_rank(), process_count=be.get_world_size(),
-                seed=args.seed + epoch,
+                seed=args.seed + epoch, num_workers=args.num_workers,
             )
             return batch_tar_stream(stream, args.batch_size)
     else:
@@ -292,6 +305,7 @@ def main(argv=None):
             return iterate_batches(
                 dataset, args.batch_size, seed=args.seed + epoch,
                 process_index=be.get_rank(), process_count=be.get_world_size(),
+                num_workers=args.num_workers,
             )
 
     # loss: raw pixels -> frozen VAE codes -> DALLE CE loss
@@ -336,31 +350,50 @@ def main(argv=None):
         run_name=args.dalle_output_file_name, use_wandb=args.wandb,
         wandb_kwargs={"name": args.wandb_name, "entity": args.wandb_entity},
         config=dalle_cfg.to_dict(), is_root=is_root,
+        resume_run_id=(resume_meta or {}).get("wandb_run_id"),
     )
 
     out_file = f"{args.dalle_output_file_name}.pt"
     start_epoch = (resume_meta or {}).get("epoch", 0)
+    # restoring the step counter keeps save/sample cadences and checkpoint
+    # rotation continuous across resume (the reference's resume restores its
+    # global step through the DeepSpeed engine, train_dalle.py:531-532)
+    global_step = (resume_meta or {}).get("global_step", 0) or 0
+
+    def save(path, epoch, keep_n=None):
+        save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+                   keep_n=keep_n, global_step=global_step,
+                   wandb_run_id=logger.run_id)
 
     # save-before-train fail-fast (reference train_dalle.py:591-594)
     if is_root:
-        save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, start_epoch)
+        save(out_file, start_epoch)
 
     key = jax.random.PRNGKey(args.seed + 1)
-    global_step = 0
     for epoch in range(start_epoch, args.epochs):
         t_window = time.time()
-        for batch in data_iter(epoch):
+        window_start = global_step  # reset with t_window: a stale window
+        # start would count the previous epoch's tail steps against a dt
+        # that excludes their wall time
+        batches = data_iter(epoch)
+        if args.prefetch_batches > 0:
+            # async host->device transfer, overlapping decode + DMA with the
+            # running step (the reference's DataLoader workers + async .cuda())
+            batches = prefetch_to_device(batches, size=args.prefetch_batches)
+        for device_batch in batches:
             key, sk = jax.random.split(key)
             device_batch = {
-                "text": jnp.asarray(batch["text"]),
-                "image": jnp.asarray(batch["image"]),
+                "text": jnp.asarray(device_batch["text"]),
+                "image": jnp.asarray(device_batch["image"]),
             }
             state, metrics = step_fn(state, device_batch, sk)
 
             if global_step % 10 == 0:
                 dt = time.time() - t_window
-                sample_per_sec = args.batch_size * 10 / dt if global_step else 0.0
+                steps_done = global_step - window_start + 1
+                sample_per_sec = args.batch_size * steps_done / max(dt, 1e-9)
                 t_window = time.time()
+                window_start = global_step + 1
                 logger.log(
                     {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch,
                      "sample_per_sec": sample_per_sec},
@@ -368,8 +401,7 @@ def main(argv=None):
                 )
             if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and is_root:
                 step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
-                save_model(step_file, state, dalle_cfg, vae_params, vae_cfg, epoch,
-                           keep_n=args.keep_n_checkpoints)
+                save(step_file, epoch, keep_n=args.keep_n_checkpoints)
             if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
                 _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
             if args.flops_profiler:
@@ -383,11 +415,11 @@ def main(argv=None):
             global_step += 1
 
         if is_root:
-            save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, epoch + 1)
+            save(out_file, epoch + 1)
             logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
 
     if is_root:
-        save_model(out_file, state, dalle_cfg, vae_params, vae_cfg, args.epochs)
+        save(out_file, args.epochs)
         logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
     logger.finish()
     return state, dalle_cfg

@@ -14,9 +14,13 @@ arrays; batches are contiguous so the host→device transfer is a single DMA;
 per-process sharding replaces DistributedSampler."""
 from __future__ import annotations
 
+import collections
 import io
+import queue
 import random
 import tarfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -105,30 +109,63 @@ class TextImageDataset:
     def __len__(self) -> int:
         return len(self.keys)
 
-    def _skip(self, ind: int):
+    def _skip(self, ind: int, rng: random.Random):
         if self.shuffle:
-            return self[self._rng.randint(0, len(self) - 1)]
-        return self[0] if ind >= len(self) - 1 else self[ind + 1]
+            return self.get(rng.randint(0, len(self) - 1), rng)
+        return self.get(0 if ind >= len(self) - 1 else ind + 1, rng)
 
-    def __getitem__(self, ind: int):
+    def get(self, ind: int, rng: random.Random):
+        """Load one sample using the GIVEN rng for caption choice and crop —
+        per-item rngs make worker-pool loading deterministic regardless of
+        thread scheduling (stricter than the reference's per-worker torch
+        generators)."""
         key = self.keys[ind]
         descriptions = [d for d in self.text_files[key].read_text().split("\n") if d]
         if not descriptions:
             print(f"An exception occurred trying to load file {self.text_files[key]}. Skipping index {ind}")
-            return self._skip(ind)
-        description = self._rng.choice(descriptions)
+            return self._skip(ind, rng)
+        description = rng.choice(descriptions)
         tokens = self.tokenizer.tokenize(
             description, self.text_len, truncate_text=self.truncate_captions
         )[0]
         try:
             img = Image.open(self.image_files[key])
             img = random_resized_crop(
-                img.convert(self.mode), self.image_size, self._rng, scale=(self.resize_ratio, 1.0)
+                img.convert(self.mode), self.image_size, rng, scale=(self.resize_ratio, 1.0)
             )
         except _PIL_ERRORS:
             print(f"An exception occurred trying to load file {self.image_files[key]}. Skipping index {ind}")
-            return self._skip(ind)
+            return self._skip(ind, rng)
         return tokens, _image_to_array(img, self.mode)
+
+    def __getitem__(self, ind: int):
+        return self.get(ind, self._rng)
+
+
+def _item_rng(seed: int, epoch: int, index: int) -> random.Random:
+    """Deterministic per-sample rng — identical whether samples load serially
+    or on a worker pool (int-tuple hashes are stable in CPython)."""
+    return random.Random(hash((seed, epoch, int(index))))
+
+
+def _parallel_map_ordered(fn, items: Iterable, workers: int, lookahead: int) -> Iterator:
+    """Ordered map over a thread pool with a bounded number of in-flight
+    items — the decode/crop worker pool (the reference's DataLoader
+    num_workers, /root/reference/train_dalle.py:405-412).  PIL decode and
+    numpy conversion release the GIL, so threads parallelize the hot part
+    without pickling costs."""
+    if workers <= 0:
+        for x in items:
+            yield fn(x)
+        return
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        dq: collections.deque = collections.deque()
+        for x in items:
+            dq.append(ex.submit(fn, x))
+            while len(dq) >= max(lookahead, workers):
+                yield dq.popleft().result()
+        while dq:
+            yield dq.popleft().result()
 
 
 def iterate_batches(
@@ -140,9 +177,12 @@ def iterate_batches(
     process_count: int = 1,
     drop_last: bool = True,
     epochs: Optional[int] = 1,
+    num_workers: int = 0,
 ) -> Iterator[dict]:
     """Batches as {'text': (B, text_len) int64, 'image': (B, H, W, C) f32}.
-    Indices are sharded across processes (DistributedSampler equivalent)."""
+    Indices are sharded across processes (DistributedSampler equivalent).
+    num_workers > 0 decodes/crops samples on a thread pool; per-item rngs
+    keep the output bit-identical to the serial path."""
     n = len(dataset)
     epoch = 0
     while epochs is None or epoch < epochs:
@@ -150,16 +190,87 @@ def iterate_batches(
         if shuffle:
             np.random.RandomState(seed + epoch).shuffle(order)
         order = order[process_index::process_count]
-        for i in range(0, len(order) - (batch_size - 1 if drop_last else 0), batch_size):
-            idx = order[i : i + batch_size]
-            if drop_last and len(idx) < batch_size:
-                break
-            items = [dataset[int(j)] for j in idx]
+        usable = len(order) - (len(order) % batch_size if drop_last else 0)
+        order = order[:usable]
+        if not len(order):
+            epoch += 1
+            continue
+
+        e = epoch  # bind for the closure
+
+        def load(j):
+            return dataset.get(int(j), _item_rng(seed, e, int(j)))
+
+        items = _parallel_map_ordered(
+            load, order, num_workers, lookahead=2 * batch_size
+        )
+        batch: List = []
+        for item in items:
+            batch.append(item)
+            if len(batch) == batch_size:
+                yield {
+                    "text": np.stack([t for t, _ in batch]),
+                    "image": np.stack([im for _, im in batch]),
+                }
+                batch = []
+        if batch and not drop_last:
             yield {
-                "text": np.stack([t for t, _ in items]),
-                "image": np.stack([im for _, im in items]),
+                "text": np.stack([t for t, _ in batch]),
+                "image": np.stack([im for _, im in batch]),
             }
         epoch += 1
+
+
+def prefetch_to_device(batches: Iterable[dict], size: int = 2) -> Iterator:
+    """Move batches onto the accelerator from a background thread, keeping
+    `size` batches in flight — host decode and the device step overlap, and
+    the next batch's host->device DMA happens during the current step (the
+    double-buffering the reference gets from DataLoader prefetch + CUDA async
+    .cuda() calls).  Works on any pytree of numpy arrays."""
+    import jax
+
+    q: queue.Queue = queue.Queue(maxsize=max(size, 1))
+    sentinel = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded put that gives up when the consumer is gone — an abandoned
+        # generator (step error, early break) must not leave this thread
+        # blocked forever holding `size` device batches in HBM
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for b in batches:
+                if not _put(jax.tree_util.tree_map(jax.device_put, b)):
+                    return
+            _put(sentinel)
+        except BaseException as e:  # propagate into the consumer
+            _put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()  # unblock + drain the producer on any exit path
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 class ImageDataset:
@@ -219,41 +330,64 @@ def iterate_tar_shards(
     process_count: int = 1,
     handler: Callable = _warn_and_continue,
     seed: int = 0,
+    num_workers: int = 0,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Stream (text_tokens, image_array) pairs from .tar shards, grouping
-    members by basename like WebDataset; shards are split across processes."""
-    rng = random.Random(seed)
-    for shard in list(shards)[process_index::process_count]:
-        try:
-            tf = tarfile.open(shard)
-        except (OSError, tarfile.TarError) as e:
-            handler(e, shard)
-            continue
-        with tf:
-            samples = {}
-            for member in tf.getmembers():
-                if not member.isfile():
-                    continue
-                stem, _, ext = member.name.rpartition(".")
-                samples.setdefault(stem, {})[ext.lower()] = member
-            for stem, members in samples.items():
-                img_member = None
-                for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
-                    if ext in members:
-                        img_member = members[ext]
-                        break
-                if img_member is None or caption_key not in members:
-                    continue
-                try:
-                    caption = tf.extractfile(members[caption_key]).read().decode("utf-8").strip()
-                    if not caption:
+    members by basename like WebDataset; shards are split across processes.
+    num_workers > 0 moves JPEG decode + crop + tokenize onto a thread pool
+    (tar byte reads stay serial — tarfile handles are not thread-safe);
+    per-item rngs keep output identical to the serial path."""
+
+    def raw_entries() -> Iterator[Tuple[str, bytes, bytes, int]]:
+        counter = 0
+        for shard in list(shards)[process_index::process_count]:
+            try:
+                tf = tarfile.open(shard)
+            except (OSError, tarfile.TarError) as e:
+                handler(e, shard)
+                continue
+            with tf:
+                samples: dict = {}
+                for member in tf.getmembers():
+                    if not member.isfile():
                         continue
-                    img = Image.open(io.BytesIO(tf.extractfile(img_member).read()))
-                    img = random_resized_crop(img.convert("RGB"), image_size, rng)
-                    tokens = tokenizer.tokenize(caption, text_len, truncate_text=truncate_captions)[0]
-                    yield tokens, _image_to_array(img, "RGB")
-                except Exception as e:  # noqa: BLE001 — warn_and_continue parity
-                    handler(e, f"{shard}:{stem}")
+                    stem, _, ext = member.name.rpartition(".")
+                    samples.setdefault(stem, {})[ext.lower()] = member
+                for stem, members in samples.items():
+                    img_member = None
+                    for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
+                        if ext in members:
+                            img_member = members[ext]
+                            break
+                    if img_member is None or caption_key not in members:
+                        continue
+                    try:
+                        caption_bytes = tf.extractfile(members[caption_key]).read()
+                        img_bytes = tf.extractfile(img_member).read()
+                    except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+                        handler(e, f"{shard}:{stem}")
+                        continue
+                    yield f"{shard}:{stem}", caption_bytes, img_bytes, counter
+                    counter += 1
+
+    def decode(entry):
+        name, caption_bytes, img_bytes, idx = entry
+        try:
+            caption = caption_bytes.decode("utf-8").strip()
+            if not caption:
+                return None
+            rng = _item_rng(seed, 0, idx)
+            img = Image.open(io.BytesIO(img_bytes))
+            img = random_resized_crop(img.convert("RGB"), image_size, rng)
+            tokens = tokenizer.tokenize(caption, text_len, truncate_text=truncate_captions)[0]
+            return tokens, _image_to_array(img, "RGB")
+        except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+            handler(e, name)
+            return None
+
+    for item in _parallel_map_ordered(decode, raw_entries(), num_workers, lookahead=64):
+        if item is not None:
+            yield item
 
 
 def batch_tar_stream(stream: Iterable, batch_size: int) -> Iterator[dict]:

@@ -23,6 +23,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -356,15 +357,23 @@ def _flash(q, k, v, mask, live, causal, scale, block_q, block_k, bwd_impl):
 
 def _flash_vjp_fwd(q, k, v, mask, live, causal, scale, block_q, block_k, bwd_impl):
     out, lse = _flash_fwd(q, k, v, mask, live, causal, scale, block_q, block_k)
-    return out, (q, k, v, mask, live, out, lse)
+    # Residuals carry checkpoint names so a selective remat policy
+    # (save_only_these_names('flash_out', 'flash_lse')) can keep them across a
+    # jax.checkpoint boundary — the backward then never re-runs the forward
+    # kernel (whole-layer remat would).  lse rows are broadcast over the lane
+    # dim; save one lane and re-broadcast in the backward.
+    out = checkpoint_name(out, "flash_out")
+    lse1 = checkpoint_name(lse[:, :, :1], "flash_lse")
+    return out, (q, k, v, mask, live, out, lse1)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, bwd_impl, res, do):
-    q, k, v, mask, live, out, lse = res
+    q, k, v, mask, live, out, lse1 = res
     if bwd_impl == "pallas":
+        lse = jnp.broadcast_to(lse1, (*lse1.shape[:2], _LANES))
         dq, dk, dv = _flash_bwd(q, k, v, do, out, lse, mask, live, causal, scale, block_q, block_k)
     else:
-        dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, lse, do)
+        dq, dk, dv = _dense_recompute_grads(q, k, v, mask, causal, scale, lse1, do)
     return dq, dk, dv, None, None
 
 

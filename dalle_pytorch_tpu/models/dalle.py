@@ -48,6 +48,9 @@ class DALLEConfig:
     share_input_output_emb: bool = False
     execution: Optional[str] = None  # None -> 'reversible' if reversible else 'sequential'
     scan_layers: bool = False  # lax.scan over layers (fast compiles at high depth)
+    # selective remat save policy for execution='remat'
+    # ('full' | 'flash' | 'flash_qkv' | 'flash_qkv_ff' — TransformerConfig.remat_policy)
+    remat_policy: str = "full"
     # image side, derived from the VAE that produced the codes
     num_image_tokens: int = 512
     image_fmap_size: int = 32
@@ -101,6 +104,7 @@ class DALLEConfig:
             shared_ff_ids=self.shared_ff_ids,
             execution=self.resolved_execution,
             scan_layers=self.scan_layers,
+            remat_policy=self.remat_policy,
             conv_kernel_size=self.conv_kernel_size,
             conv_dilation=self.conv_dilation,
             sparse_block_size=self.sparse_block_size,

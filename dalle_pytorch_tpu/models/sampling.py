@@ -197,17 +197,30 @@ def generate_texts(
     text: Optional[jnp.ndarray] = None,
     filter_thres: float = 0.5,
     temperature: float = 1.0,
+    use_cache: bool = True,
 ) -> jnp.ndarray:
     """Text completion (the reference's generate_texts,
-    dalle_pytorch.py:459-504): no bos, no pad-remap, full re-forward per step
-    over a fixed-size buffer with causal masking.  text: (b, n0) prompt ids
-    (defaults to a single 0 token).  Returns (b, text_seq_len) token ids."""
+    dalle_pytorch.py:459-504): no bos, no pad-remap.  text: (b, n0) prompt
+    ids (defaults to a single 0 token).  Returns (b, text_seq_len) ids.
+
+    use_cache=True runs prefill + KV-cached single-token decode steps —
+    O(text_len) work per token instead of the reference's full
+    O(text_len^2 * depth) re-forward per token (its own generate_texts never
+    caches).  use_cache=False keeps the reference-shaped re-forward loop;
+    both paths consume the identical RNG stream, so outputs agree."""
     if text is None:
         text = jnp.zeros((1, 1), jnp.int32)
+    text = text.astype(jnp.int32)
     b, n0 = text.shape
     ts = cfg.text_seq_len
+    if n0 >= ts:
+        return text[:, :ts]
+    if use_cache:
+        return _generate_texts_cached(
+            params, cfg, key, text, filter_thres=filter_thres, temperature=temperature
+        )
     buf = jnp.zeros((b, ts), jnp.int32)
-    buf = jax.lax.dynamic_update_slice(buf, text.astype(jnp.int32), (0, 0))
+    buf = jax.lax.dynamic_update_slice(buf, text, (0, 0))
 
     tcfg = cfg.transformer_config()
     mask_rows = dalle_mod.logits_mask_slice(cfg, ts)
@@ -230,3 +243,69 @@ def generate_texts(
 
     buf, _ = jax.lax.fori_loop(n0, ts, step, (buf, key))
     return buf
+
+
+@partial(jax.jit, static_argnames=("cfg", "filter_thres", "temperature"))
+def _generate_texts_cached(
+    params: dict,
+    cfg: DALLEConfig,
+    key: jax.Array,
+    text: jnp.ndarray,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """KV-cached text completion: prefill the (b, n0) prompt once, then one
+    decode_step per generated token (text_only — the token shift is the
+    identity in the text region)."""
+    b, n0 = text.shape
+    ts = cfg.text_seq_len
+    tcfg = cfg.transformer_config()
+    mask_rows = dalle_mod.logits_mask_slice(cfg, ts)
+    table = dalle_mod._text_table(params, cfg)
+
+    def embed(ids, start):
+        e = jnp.take(table, ids, axis=0, mode="clip")
+        if not cfg.rotary_emb:
+            pos = jnp.take(
+                params["text_pos"]["table"],
+                start + jnp.arange(ids.shape[1]),
+                axis=0,
+                mode="clip",
+            )
+            e = e + pos
+        return e
+
+    def logits_row(out1, pos):
+        if cfg.stable:
+            out1 = divide_max(out1)
+        lg = dalle_mod.to_logits(params, cfg, out1)[:, 0]
+        row = jax.lax.dynamic_slice(mask_rows, (pos, 0), (1, cfg.total_tokens))[0]
+        return jnp.where(row[None, :], jnp.finfo(lg.dtype).min, lg)
+
+    def sample_from(lg, sk):
+        return gumbel_sample(
+            sk, top_k_filter(lg, thres=filter_thres), temperature=temperature
+        ).astype(jnp.int32)
+
+    cache = init_cache(tcfg, b, dtype=params["logits_linear"]["w"].dtype)
+    out, cache = prefill(params["transformer"], tcfg, embed(text, 0), cache)
+
+    key, sk = jax.random.split(key)
+    tok0 = sample_from(logits_row(out[:, -1:], n0 - 1), sk)
+
+    def body(carry, _):
+        cache, prev, key = carry
+        x = embed(prev[:, None], cache["offset"])
+        out1, cache = decode_step(params["transformer"], tcfg, x, cache, text_only=True)
+        lg = logits_row(out1, cache["offset"] - 1)
+        key, sk = jax.random.split(key)
+        tok = sample_from(lg, sk)
+        return (cache, tok, key), tok
+
+    n_rest = ts - n0 - 1
+    if n_rest > 0:
+        _, rest = jax.lax.scan(body, (cache, tok0, key), None, length=n_rest)
+        gen = jnp.concatenate([tok0[None], rest], axis=0).T  # (b, ts - n0)
+    else:
+        gen = tok0[:, None]
+    return jnp.concatenate([text, gen], axis=1)

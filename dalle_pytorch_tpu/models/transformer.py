@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from dalle_pytorch_tpu.core.module import dropout as apply_dropout
 from dalle_pytorch_tpu.core.module import (
@@ -62,6 +63,17 @@ class TransformerConfig:
     shared_attn_ids: Optional[Tuple[int, ...]] = None
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     execution: str = "sequential"  # 'sequential' | 'remat' | 'reversible'
+    # Selective rematerialization policy for execution='remat':
+    #   'full'      — save nothing, recompute the whole layer (jax.checkpoint
+    #                 default; the round-2 behavior, which re-ran the flash
+    #                 forward kernel in the backward for nothing — the Pallas
+    #                 backward only needs q,k,v + the saved out/lse)
+    #   'flash'     — save flash attention out + logsumexp
+    #   'flash_qkv' — also save the qkv projection (the flash backward's other
+    #                 input), leaving only the ff up-projection to recompute
+    #   'flash_qkv_ff' — also save the ff pre-activation: backward recomputes
+    #                 no matmuls at all (max memory; for chips with headroom)
+    remat_policy: str = "full"
     # lax.scan over stacked layer params instead of an unrolled python loop:
     # near-constant compile time in depth (essential for depth-64 configs).
     # Requires unshared layers; composes with execution='remat'.
@@ -123,6 +135,29 @@ def derive_layer_specs(cfg: TransformerConfig) -> List[LayerSpec]:
     return specs
 
 
+_REMAT_SAVE_NAMES = {
+    "flash": ("flash_out", "flash_lse"),
+    "flash_qkv": ("flash_out", "flash_lse", "attn_qkv"),
+    "flash_qkv_ff": ("flash_out", "flash_lse", "attn_qkv", "ff_pre"),
+}
+
+
+def _remat_wrap(fn, cfg: "TransformerConfig"):
+    """jax.checkpoint with the config's selective save policy (see
+    TransformerConfig.remat_policy)."""
+    if cfg.remat_policy in (None, "full"):
+        return jax.checkpoint(fn)
+    if cfg.remat_policy not in _REMAT_SAVE_NAMES:
+        raise ValueError(
+            f"remat_policy {cfg.remat_policy!r} is not valid; choose from "
+            f"'full', {', '.join(map(repr, _REMAT_SAVE_NAMES))}"
+        )
+    names = _REMAT_SAVE_NAMES[cfg.remat_policy]
+    return jax.checkpoint(
+        fn, policy=jax.checkpoint_policies.save_only_these_names(*names)
+    )
+
+
 def _layerscale_eps(layer_one_indexed: int) -> float:
     if layer_one_indexed <= 18:
         return 0.1
@@ -174,13 +209,15 @@ def transformer_rotary(cfg: TransformerConfig) -> Optional[jnp.ndarray]:
     return build_dalle_rotary(cfg.dim_head, cfg.text_len, cfg.image_fmap_size)
 
 
-def _pattern_for(cfg: TransformerConfig, attn_type: str):
+def _pattern_for(cfg: TransformerConfig, attn_type: str, seed: int = 0):
     """(seq_len, seq_len) NUMPY pattern mask or None for 'full'.
 
     Kept as numpy (not jnp) deliberately: under jit, any jnp op on a constant
     yields a tracer, which would defeat the Pallas kernel's trace-time
     tile-liveness derivation.  Numpy slices stay concrete; conversion to a
-    device constant happens at the op boundary."""
+    device constant happens at the op boundary.
+
+    `seed` picks the random block layout for 'sparse' (see _pattern_seed)."""
     from dalle_pytorch_tpu.ops.masks import _block_sparse_mask_np, _pattern_mask_np
 
     if attn_type == "full":
@@ -190,11 +227,41 @@ def _pattern_for(cfg: TransformerConfig, attn_type: str):
         if nr is None:
             nr = cfg.seq_len // cfg.sparse_block_size // 4
         return _block_sparse_mask_np(
-            cfg.seq_len, cfg.image_fmap_size, cfg.sparse_block_size, nr, 4, 0
+            cfg.seq_len, cfg.image_fmap_size, cfg.sparse_block_size, nr, 4, seed
         )
     return _pattern_mask_np(
         attn_type, cfg.seq_len, cfg.image_fmap_size, cfg.conv_kernel_size, cfg.conv_dilation
     )
+
+
+def _pattern_seed(spec: LayerSpec) -> int:
+    """Random-layout seed for a 'sparse' layer: keyed by the shared-attention
+    id, so the layout is a property of the attention *module*.  This mirrors
+    the reference, where each SparseSelfAttention instance draws its own
+    random blocks at module init (attention.py:349-365) — distinct layers get
+    distinct layouts (union coverage across depth), while weight-shared layers
+    (shared_attn_ids) reuse the instance and hence its layout."""
+    try:
+        return int(spec.attn_id)
+    except ValueError:
+        import zlib
+
+        # crc32, NOT hash(): str hashing is randomized per process
+        # (PYTHONHASHSEED) — a per-process layout would silently diverge
+        # across multi-host replicas and across checkpoint resumes
+        return zlib.crc32(spec.attn_id.encode())
+
+
+def _pattern_key(spec: LayerSpec) -> Tuple[str, int]:
+    """Dict key identifying a layer's pattern (type + layout seed)."""
+    return (spec.attn_type, _pattern_seed(spec) if spec.attn_type == "sparse" else 0)
+
+
+def spec_patterns(cfg: TransformerConfig, specs: List[LayerSpec]) -> Dict[Tuple[str, int], object]:
+    """One pattern mask per distinct (attn_type, seed) across the given specs."""
+    return {
+        _pattern_key(s): _pattern_for(cfg, s.attn_type, _pattern_seed(s)) for s in specs
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -244,7 +311,7 @@ def _use_ring(cfg, pattern, key_mask) -> bool:
 
 def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
     b, n, _ = x.shape
-    qkv = linear(shared["qkv"], x)
+    qkv = checkpoint_name(linear(shared["qkv"], x), "attn_qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_split_heads(t, cfg.heads) for t in (q, k, v))
     if rotary is not None:
@@ -306,7 +373,7 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
 
 
 def _feed_forward(shared, cfg, x, dkey):
-    h = linear(shared["w1"], x)
+    h = checkpoint_name(linear(shared["w1"], x), "ff_pre")
     a, gates = jnp.split(h, 2, axis=-1)
     h = a * jax.nn.gelu(gates, approximate=False)  # exact erf, as the reference's F.gelu
     h = apply_dropout(dkey, h, cfg.ff_dropout)
@@ -357,6 +424,7 @@ def _residual_branch(
     live=None,
     layer_cache: Optional[dict] = None,
     offset=None,
+    text_mode: bool = False,
 ):
     """THE residual branch — PreShiftToken? -> PreNorm -> attn/ff -> sandwich?
     -> LayerScale — shared by full-sequence apply, scan-layers, prefill and
@@ -366,10 +434,16 @@ def _residual_branch(
     h = layer_norm(wrap[f"{kind}_norm"], x)
     if cfg.shift_tokens:
         if mode == "decode":
-            layer_cache = dict(layer_cache)
-            h, layer_cache[f"shift_{kind}"] = _shift_cached_step(
-                cfg, layer_cache[f"shift_{kind}"], h, offset
-            )
+            if text_mode:
+                # token shift is the identity for text-only sequences
+                # (ops/shift.py:45-47 — n < text_len passes through), so a
+                # text-region decode step skips the cached shift entirely
+                pass
+            else:
+                layer_cache = dict(layer_cache)
+                h, layer_cache[f"shift_{kind}"] = _shift_cached_step(
+                    cfg, layer_cache[f"shift_{kind}"], h, offset
+                )
         else:
             if mode == "prefill":
                 # raw (normed, pre-shift) values feed the ring buffer
@@ -425,7 +499,7 @@ def apply_transformer(
     """x: (batch, n, dim) with n <= seq_len.  Full-sequence (training) mode."""
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
-    patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
+    patterns = spec_patterns(cfg, specs)
 
     has_dropout = (cfg.attn_dropout > 0 or cfg.ff_dropout > 0) and dropout_key is not None
     if has_dropout:
@@ -443,7 +517,7 @@ def apply_transformer(
         )
 
     def branch(spec, x, kind, dkey):
-        return _branch(params, cfg, spec, x, kind, rotary, patterns[spec.attn_type], key_mask, dkey)
+        return _branch(params, cfg, spec, x, kind, rotary, patterns[_pattern_key(spec)], key_mask, dkey)
 
     if cfg.execution == "reversible":
         f_fns = []
@@ -451,13 +525,13 @@ def apply_transformer(
         for spec in specs:
             f_fns.append(
                 lambda p, h, k, s=spec: _branch(
-                    p, cfg, s, h, "attn", rotary, patterns[s.attn_type], key_mask,
+                    p, cfg, s, h, "attn", rotary, patterns[_pattern_key(s)], key_mask,
                     k if has_dropout else None,
                 )
             )
             g_fns.append(
                 lambda p, h, k, s=spec: _branch(
-                    p, cfg, s, h, "ff", rotary, patterns[s.attn_type], key_mask,
+                    p, cfg, s, h, "ff", rotary, patterns[_pattern_key(s)], key_mask,
                     k if has_dropout else None,
                 )
             )
@@ -484,7 +558,7 @@ def apply_transformer(
             return seq_constraint(x)
 
         if cfg.execution == "remat":
-            x = jax.checkpoint(block)(x)
+            x = _remat_wrap(block, cfg)(x)
         else:
             x = block(x)
     return x
@@ -508,7 +582,7 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
         resolve_block,
     )
 
-    distinct = list(dict.fromkeys(s.attn_type for s in specs))
+    distinct = list(dict.fromkeys(_pattern_key(s) for s in specs))
     masks_np, lives_np = [], []
     # liveness granularity must match the kernel's RESOLVED block sizes
     try:
@@ -517,8 +591,8 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
         derive_live = True
     except ValueError:  # no valid block: the flash path won't be taken anyway
         derive_live = False
-    for t in distinct:
-        pm = _pattern_for(cfg, t)
+    for t, seed in distinct:
+        pm = _pattern_for(cfg, t, seed)
         m = np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n]
         masks_np.append(m)
         if derive_live:
@@ -527,7 +601,7 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
             )
     masks = jnp.asarray(np.stack(masks_np))
     lives = jnp.asarray(np.stack(lives_np)) if derive_live else None
-    midx = jnp.asarray([distinct.index(s.attn_type) for s in specs], jnp.int32)
+    midx = jnp.asarray([distinct.index(_pattern_key(s)) for s in specs], jnp.int32)
 
     bundles = [
         {
@@ -561,7 +635,7 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
         return seq_constraint(h), None
 
     if cfg.execution == "remat":
-        body = jax.checkpoint(body)
+        body = _remat_wrap(body, cfg)
 
     xs = (stacked, midx, layer_keys) if layer_keys is not None else (stacked, midx)
     out, _ = jax.lax.scan(body, seq_constraint(x), xs)
@@ -671,21 +745,23 @@ def decode_step(
     cfg: TransformerConfig,
     x: jnp.ndarray,
     cache: dict,
+    text_only: bool = False,
 ) -> Tuple[jnp.ndarray, dict]:
     """Process ONE token (b, 1, dim) at position cache['offset'].  Sampling
     runs with dropout disabled (eval mode), matching the reference's
-    eval_decorator."""
+    eval_decorator.  text_only: the decode position is in the text region
+    (generate_texts) — the token shift is skipped (identity there)."""
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
-    patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
+    patterns = spec_patterns(cfg, specs)
     offset = cache["offset"]
 
     def branch(spec, x, kind, layer_cache):
         return _residual_branch(
             cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
             params["shared_ff"][spec.ff_id], x, kind, mode="decode",
-            rotary=rotary, pattern=patterns[spec.attn_type],
-            layer_cache=layer_cache, offset=offset,
+            rotary=rotary, pattern=patterns[_pattern_key(spec)],
+            layer_cache=layer_cache, offset=offset, text_mode=text_only,
         )
 
     out, new_layers = _run_cached_layers(cfg, specs, x, cache, branch)
@@ -704,13 +780,13 @@ def prefill(
     n = x.shape[1]
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
-    patterns = {s.attn_type: _pattern_for(cfg, s.attn_type) for s in specs}
+    patterns = spec_patterns(cfg, specs)
 
     def branch(spec, x, kind, layer_cache):
         return _residual_branch(
             cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
             params["shared_ff"][spec.ff_id], x, kind, mode="prefill",
-            rotary=rotary, pattern=patterns[spec.attn_type], key_mask=key_mask,
+            rotary=rotary, pattern=patterns[_pattern_key(spec)], key_mask=key_mask,
             layer_cache=layer_cache,
         )
 

@@ -47,6 +47,12 @@ class StepSettings:
     compute_dtype: Any = jnp.float32
     clip_grad_norm: Optional[float] = None
     zero_stage: int = 0
+    # dtype gradients are kept in between backward and the optimizer update.
+    # f32 is the safe default; bf16 halves the gradient buffer (the single-chip
+    # memory wall for billion-parameter configs) and is sound with
+    # scale-invariant optimizers like adafactor.  Accumulation across
+    # microbatches always runs in f32.
+    grad_dtype: Any = jnp.float32
 
 
 def make_train_step(
@@ -83,7 +89,7 @@ def make_train_step(
 
         if accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(compute_params, batch, key)
-            return cast_floating(grads, jnp.float32), loss
+            return cast_floating(grads, settings.grad_dtype), loss
 
         micro = jax.tree_util.tree_map(
             lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
@@ -104,7 +110,10 @@ def make_train_step(
         )
         (g, l), _ = jax.lax.scan(body, (zero, 0.0), (micro, keys))
         scale = 1.0 / accum
-        return jax.tree_util.tree_map(lambda x: x * scale, g), l * scale
+        g = jax.tree_util.tree_map(
+            lambda x: (x * scale).astype(settings.grad_dtype), g
+        )
+        return g, l * scale
 
     # allow schedules that consume the loss (e.g. reduce_on_plateau)
     optimizer = optax.with_extra_args_support(optimizer)

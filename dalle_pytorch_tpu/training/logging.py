@@ -36,10 +36,15 @@ def make_grid(images: np.ndarray, nrow: int = 4, pad: int = 2,
 class MetricLogger:
     def __init__(self, run_name: str = "run", log_dir: str = ".", use_wandb: bool = False,
                  wandb_kwargs: Optional[dict] = None, config: Optional[dict] = None,
-                 is_root: bool = True):
+                 is_root: bool = True, resume_run_id: Optional[str] = None):
+        """resume_run_id: a wandb run id persisted in a checkpoint — resuming
+        training reattaches to the same run (the reference resumes its run,
+        train_dalle.py:463-476) instead of starting a fresh one.  The active
+        id is exposed as .run_id for checkpointing."""
         self.is_root = is_root
         self._wandb = None
         self._file = None
+        self.run_id: Optional[str] = resume_run_id
         self._image_dir = Path(log_dir) / f"{run_name}.images"
         if not is_root:
             return
@@ -48,7 +53,12 @@ class MetricLogger:
                 import wandb
 
                 self._wandb = wandb
-                wandb.init(config=config or {}, **(wandb_kwargs or {}))
+                kw = dict(wandb_kwargs or {})
+                if resume_run_id is not None:
+                    kw.setdefault("id", resume_run_id)
+                    kw.setdefault("resume", "allow")
+                run = wandb.init(config=config or {}, **kw)
+                self.run_id = getattr(run, "id", resume_run_id)
             except Exception as e:  # pragma: no cover
                 print(f"[logging] wandb unavailable ({e!r}); falling back to JSONL")
         path = Path(log_dir) / f"{run_name}.metrics.jsonl"

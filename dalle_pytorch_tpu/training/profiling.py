@@ -56,12 +56,44 @@ def matmul_param_count(params: Any) -> int:
     return int(total)
 
 
+def _attn_live_density(cfg) -> float:
+    """Mean live fraction of the (s, s) score matrix across layers, counting
+    only positions the attention may actually attend to (pattern AND causal).
+    A full causal layer contributes ~0.5; axial/conv/block-sparse layers
+    contribute their true (lower) density — pricing masked-out positions as
+    useful FLOPs would inflate the MFU (the kernels skip dead tiles)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.transformer import (
+        _pattern_for, _pattern_seed, derive_layer_specs,
+    )
+
+    tcfg = cfg.transformer_config() if hasattr(cfg, "transformer_config") else cfg
+    n = tcfg.seq_len
+    tri_mean = (n + 1) / (2.0 * n)  # mean of the causal triangle
+    cache: dict = {}
+    dens = []
+    for spec in derive_layer_specs(tcfg):
+        key = (spec.attn_type, _pattern_seed(spec) if spec.attn_type == "sparse" else 0)
+        if key not in cache:
+            pm = _pattern_for(tcfg, spec.attn_type, key[1])
+            if pm is None:
+                cache[key] = tri_mean
+            else:
+                tri = np.tril(np.ones((n, n), dtype=bool))
+                cache[key] = float((np.asarray(pm) & tri).mean())
+        dens.append(cache[key])
+    return sum(dens) / len(dens)
+
+
 def dalle_step_flops(cfg, batch: int, n_matmul_params: int, with_backward: bool = True) -> float:
-    """Analytic FLOPs for one (micro)step: 2*P*T matmul cost + causal
-    attention scores/values; backward ≈ 2x forward."""
+    """Analytic FLOPs for one (micro)step: 2*P*T matmul cost + attention
+    scores/values priced at each layer's live (pattern & causal) density;
+    backward ≈ 2x forward."""
     s = cfg.total_seq_len
     proj = 2.0 * n_matmul_params * batch * s
-    attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * 0.5 * cfg.depth
+    density = _attn_live_density(cfg)
+    attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * density * cfg.depth
     fwd = proj + attn
     return (3.0 if with_backward else 1.0) * fwd
 

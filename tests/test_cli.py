@@ -124,6 +124,14 @@ def test_train_dalle_cli_and_checkpoint_payload(trained_dalle):
 
 
 def test_train_dalle_resume(workspace, trained_dalle):
+    import json
+
+    from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+    _, meta0 = load_checkpoint(str(trained_dalle))
+    # 24 samples / batch 8 = 3 steps in the first 1-epoch run
+    assert meta0["global_step"] == 3
+
     state, cfg = train_dalle_cli.main([
         "--dalle_path", str(trained_dalle),
         "--image_text_folder", str(workspace / "data"),
@@ -135,6 +143,18 @@ def test_train_dalle_resume(workspace, trained_dalle):
         "--truncate_captions",
     ])
     assert (workspace / "dalle_resumed.pt").exists()
+    # the step counter continues across resume (3 restored + 3 new), keeping
+    # save/sample cadences and rotation continuous
+    _, meta1 = load_checkpoint(str(workspace / "dalle_resumed.pt"))
+    assert meta1["global_step"] == 6
+    assert meta1["epoch"] == 2
+    # the throughput metric must be real (non-zero) from its very first
+    # window — the round-2 code reported 0.0 at step 0
+    records = [
+        json.loads(line) for line in open(workspace / "dalle.metrics.jsonl")
+    ]
+    rates = [r["sample_per_sec"] for r in records if "sample_per_sec" in r]
+    assert rates and all(r > 0 for r in rates)
 
 
 def test_generate_cli(workspace, trained_dalle):

@@ -111,6 +111,48 @@ def test_iterate_batches_sharding(data_folder):
     assert len(b0) + len(b1) == len(ds)
 
 
+def test_iterate_batches_workers_deterministic(data_folder):
+    """Worker-pool loading must be bit-identical to serial loading (per-item
+    rngs decouple augmentation from thread scheduling), and stable across
+    repeat runs."""
+    ds = TextImageDataset(str(data_folder), text_len=16, image_size=16,
+                          tokenizer=TOK, shuffle=True)
+    runs = [
+        list(iterate_batches(ds, batch_size=2, seed=7, num_workers=w))
+        for w in (0, 3, 3)
+    ]
+    for other in runs[1:]:
+        assert len(other) == len(runs[0])
+        for a, b in zip(runs[0], other):
+            np.testing.assert_array_equal(a["text"], b["text"])
+            np.testing.assert_array_equal(a["image"], b["image"])
+
+
+def test_prefetch_to_device_preserves_order_and_values(data_folder):
+    from dalle_pytorch_tpu.data.loader import prefetch_to_device
+
+    ds = TextImageDataset(str(data_folder), text_len=16, image_size=16, tokenizer=TOK)
+    host = list(iterate_batches(ds, batch_size=2, seed=1))
+    dev = list(prefetch_to_device(iterate_batches(ds, batch_size=2, seed=1), size=2))
+    assert len(dev) == len(host)
+    for a, b in zip(host, dev):
+        np.testing.assert_array_equal(a["text"], np.asarray(b["text"]))
+        np.testing.assert_array_equal(a["image"], np.asarray(b["image"]))
+
+
+def test_prefetch_to_device_propagates_errors():
+    from dalle_pytorch_tpu.data.loader import prefetch_to_device
+
+    def boom():
+        yield {"x": np.zeros(2)}
+        raise RuntimeError("loader failed")
+
+    it = prefetch_to_device(boom(), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader failed"):
+        list(it)
+
+
 # --- tar-shard pipeline -----------------------------------------------------
 
 @pytest.fixture()
@@ -138,6 +180,20 @@ def test_tar_pipeline(tar_shard):
     assert len(batches) == 1  # empty-caption sample filtered out
     assert batches[0]["text"].shape == (2, 16)
     assert batches[0]["image"].shape == (2, 16, 16, 3)
+
+
+def test_tar_pipeline_workers_deterministic(tar_shard):
+    def run(workers):
+        return list(iterate_tar_shards(
+            [str(tar_shard)], image_size=16, text_len=16, tokenizer=TOK,
+            num_workers=workers,
+        ))
+
+    serial, pooled = run(0), run(3)
+    assert len(serial) == len(pooled) == 2
+    for (t0, i0), (t1, i1) in zip(serial, pooled):
+        np.testing.assert_array_equal(t0, t1)
+        np.testing.assert_array_equal(i0, i1)
 
 
 def test_tar_pipeline_missing_shard_warns(tar_shard, capsys):

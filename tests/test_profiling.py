@@ -1,0 +1,51 @@
+"""MFU accounting: pattern-aware attention FLOPs.
+
+The reference prices every layer at full causal cost (it has no MFU counter
+at all — SURVEY.md §5); here masked-out attention positions must NOT count as
+useful FLOPs, since the Pallas kernels skip dead tiles."""
+import numpy as np
+
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.training.profiling import _attn_live_density, dalle_step_flops
+
+
+def _cfg(attn_types):
+    return DALLEConfig(
+        dim=64, depth=4, heads=2, dim_head=16,
+        num_text_tokens=100, text_seq_len=8,
+        num_image_tokens=64, image_fmap_size=4,
+        attn_types=attn_types, shift_tokens=False, rotary_emb=False,
+    )
+
+
+def test_full_causal_density_is_half():
+    d = _attn_live_density(_cfg(("full",)))
+    n = _cfg(("full",)).total_seq_len
+    assert abs(d - (n + 1) / (2 * n)) < 1e-9
+
+
+def test_sparse_cycle_density_below_full():
+    full = _attn_live_density(_cfg(("full",)))
+    mixed = _attn_live_density(_cfg(("full", "axial_row", "axial_col", "conv_like")))
+    assert mixed < full
+
+
+def test_density_matches_mean_of_live_positions():
+    cfg = _cfg(("axial_row",))
+    from dalle_pytorch_tpu.models.transformer import _pattern_for
+
+    tcfg = cfg.transformer_config()
+    pm = np.asarray(_pattern_for(tcfg, "axial_row"))
+    n = tcfg.seq_len
+    tri = np.tril(np.ones((n, n), bool))
+    assert abs(_attn_live_density(cfg) - (pm & tri).mean()) < 1e-9
+
+
+def test_step_flops_scale_with_density():
+    cfg_full = _cfg(("full",))
+    cfg_mixed = _cfg(("full", "axial_row", "axial_col", "conv_like"))
+    f_full = dalle_step_flops(cfg_full, 2, 10_000)
+    f_mixed = dalle_step_flops(cfg_mixed, 2, 10_000)
+    assert f_mixed < f_full
+    # projection FLOPs are unchanged; only the attention term shrinks
+    assert f_mixed > 3 * 2 * 10_000 * 2 * cfg_full.total_seq_len

@@ -175,6 +175,29 @@ def test_generate_texts():
     assert out_default.shape == (1, cfg.text_seq_len)
 
 
+@pytest.mark.parametrize("kw", [dict(), dict(rotary_emb=False), dict(stable=True)])
+def test_generate_texts_cached_matches_uncached(kw):
+    """The KV-cached path must reproduce the reference-shaped full-re-forward
+    loop.  Greedy (tiny temperature + tight top-k) removes tie sensitivity;
+    a stochastic same-key run is also compared — both paths consume the
+    identical RNG stream."""
+    cfg = tiny_cfg(**kw)
+    params, _ = setup(cfg)
+    prompt = jnp.asarray([[5, 9, 3], [1, 2, 4]], jnp.int32)
+    greedy = dict(filter_thres=0.97, temperature=1e-6)
+    a = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(0), text=prompt,
+                                  use_cache=False, **greedy))
+    b = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(0), text=prompt,
+                                  use_cache=True, **greedy))
+    np.testing.assert_array_equal(a, b)
+
+    s1 = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(3), text=prompt,
+                                   use_cache=False))
+    s2 = np.asarray(generate_texts(params, cfg, jax.random.PRNGKey(3), text=prompt,
+                                   use_cache=True))
+    np.testing.assert_array_equal(s1, s2)
+
+
 def test_noise_override_parity_mode():
     """Fixed-noise parity mode: identical noise => identical samples,
     regardless of the PRNG key; zero noise == greedy argmax."""

@@ -244,3 +244,57 @@ def test_scan_layers_rejects_sharing():
     x = jax.random.normal(jax.random.PRNGKey(1), (1, cfg.seq_len, cfg.dim))
     with pytest.raises(AssertionError, match="unshared"):
         apply_transformer(params, cfg, x)
+
+
+def test_sparse_layouts_differ_per_layer_and_share_with_ids():
+    """Each 'sparse' layer draws its own random block layout (reference:
+    attention.py:349-365 draws at module init, so layouts differ per layer);
+    weight-shared layers reuse the module and hence one layout."""
+    from dalle_pytorch_tpu.models.transformer import _pattern_key, spec_patterns
+
+    kw = dict(depth=3, attn_types=("sparse",), sparse_block_size=4,
+              sparse_num_random_blocks=2)
+    # a geometry where random blocks are not swallowed by the local window +
+    # global text blocks: 18 key blocks, window 4, 3 global
+    cfg_big = cfg_for(seq_len=72, image_fmap_size=8, **kw)
+    specs = derive_layer_specs(cfg_big)
+    pats = spec_patterns(cfg_big, specs)
+    keys = [_pattern_key(s) for s in specs]
+    assert len(set(keys)) == 3
+    mats = [np.asarray(pats[k]) for k in keys]
+    assert not (np.array_equal(mats[0], mats[1]) and np.array_equal(mats[1], mats[2]))
+    cfg = cfg_for(**kw)
+    cfg_sh = cfg_for(shared_attn_ids=(0, 0, 0), shared_ff_ids=(0, 0, 0), **kw)
+    assert len({_pattern_key(s) for s in derive_layer_specs(cfg_sh)}) == 1
+    params, x = make(cfg)
+    out = apply_transformer(params, cfg, x)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_scan_layers_matches_loop_with_per_layer_sparse():
+    """The stacked-mask scan path must select each layer's OWN sparse layout."""
+    kw = dict(attn_types=("sparse",), depth=3, sparse_block_size=4,
+              sparse_num_random_blocks=2, shift_tokens=True)
+    cfg_loop = cfg_for(**kw)
+    cfg_scan = cfg_for(scan_layers=True, **kw)
+    params, x = make(cfg_loop)
+    a = np.asarray(apply_transformer(params, cfg_loop, x))
+    b = np.asarray(apply_transformer(params, cfg_scan, x))
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_remat_policies_match_sequential():
+    """Selective remat policies are pure memory/schedule choices — outputs and
+    grads must match the sequential engine exactly."""
+    cfg_seq = cfg_for(shift_tokens=True, depth=2)
+    params, x = make(cfg_seq)
+    a = np.asarray(apply_transformer(params, cfg_seq, x))
+    ga = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_seq, x) ** 2))(params)
+    for policy in ("flash", "flash_qkv", "flash_qkv_ff"):
+        cfg_r = cfg_for(shift_tokens=True, depth=2, execution="remat",
+                        remat_policy=policy)
+        b = np.asarray(apply_transformer(params, cfg_r, x))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+        gb = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_r, x) ** 2))(params)
+        for la, lb in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
