@@ -239,9 +239,10 @@ def init_random_like(key: jax.Array) -> Dict:
             blk["id"] = conv(1, cin, cout)
         return blk
 
-    def half(widths, k_in, cin0, cout_last, decoder):
+    def half(widths, k_in, cin0, cout_last, first_width=None):
+        first = widths[0] if first_width is None else first_width
         groups = []
-        cin = widths[0]
+        cin = first
         for g, width in enumerate(widths):
             group = []
             for i in range(N_BLK_PER_GROUP):
@@ -249,14 +250,16 @@ def init_random_like(key: jax.Array) -> Dict:
                 cin = width
             groups.append(group)
         return {
-            "input": conv(k_in, cin0, widths[0]),
+            "input": conv(k_in, cin0, first),
             "groups": groups,
             "output": conv(1, widths[-1], cout_last),
         }
 
     enc_widths = [N_HID, 2 * N_HID, 4 * N_HID, 8 * N_HID]
-    dec_widths = [8 * N_HID // 2, 4 * N_HID // 2, 2 * N_HID // 2, N_HID // 2]
+    # published decoder geometry: 1x1 input conv to n_init=128, then
+    # (8, 4, 2, 1) * n_hid groups (group_1.block_1 carries the id_path conv)
+    dec_widths = [8 * N_HID, 4 * N_HID, 2 * N_HID, N_HID]
     return {
-        "encoder": half(enc_widths, 7, 3, VOCAB, decoder=False),
-        "decoder": half(dec_widths, 1, VOCAB, 6, decoder=True),
+        "encoder": half(enc_widths, 7, 3, VOCAB),
+        "decoder": half(dec_widths, 1, VOCAB, 6, first_width=128),
     }

@@ -258,9 +258,11 @@ def _pattern_key(spec: LayerSpec) -> Tuple[str, int]:
 
 
 def spec_patterns(cfg: TransformerConfig, specs: List[LayerSpec]) -> Dict[Tuple[str, int], object]:
-    """One pattern mask per distinct (attn_type, seed) across the given specs."""
+    """One pattern mask per DISTINCT (attn_type, seed) across the given specs
+    (a depth-64 model cycles 4 types — build 4 masks, not 64)."""
     return {
-        _pattern_key(s): _pattern_for(cfg, s.attn_type, _pattern_seed(s)) for s in specs
+        key: _pattern_for(cfg, key[0], key[1])
+        for key in dict.fromkeys(_pattern_key(s) for s in specs)
     }
 
 

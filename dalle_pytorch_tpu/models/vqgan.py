@@ -180,8 +180,12 @@ def get_codebook_indices(params: Dict, cfg: VQGANConfig, images: jnp.ndarray) ->
     z = encode(params, cfg, 2.0 * images - 1.0)
     b = z.shape[0]
     if cfg.is_gumbel:
-        # GumbelVQ: encoder emits logits over the codebook
-        return jnp.argmax(z, axis=-1).reshape(b, -1)
+        # GumbelVQ: codebook logits come from the quantizer's OWN projection
+        # (taming GumbelQuantize.proj) applied after quant_conv — in the
+        # published gumbel models embed_dim == z_channels so the chain
+        # quant_conv (z->embed) -> proj (z->n_embed) is shape-consistent
+        logits = _conv(params["quant_proj"], z)
+        return jnp.argmax(logits, axis=-1).reshape(b, -1)
     flat = z.reshape(b, -1, cfg.embed_dim)
     emb = params["codebook"]["table"]  # (n_embed, embed_dim)
     d = (
@@ -297,6 +301,7 @@ def convert_taming_state_dict(state: Dict, cfg: VQGANConfig) -> Dict:
 
     if cfg.is_gumbel:
         params["codebook"] = {"table": np.asarray(state["quantize.embed.weight"], np.float32)}
+        params["quant_proj"] = _cv(state, "quantize.proj")
     else:
         params["codebook"] = {"table": np.asarray(state["quantize.embedding.weight"], np.float32)}
     return params
@@ -389,7 +394,9 @@ def init_random_like(key: jax.Array, cfg: VQGANConfig) -> Dict:
     params["mid"] = {"block_1": res(cin, cin), "attn_1": attn(cin), "block_2": res(cin, cin)}
     params["norm_out"] = gn(cin)
     params["conv_out"] = conv(3, cin, cfg.z_channels)
-    params["quant_conv"] = conv(1, cfg.z_channels, cfg.n_embed if cfg.is_gumbel else cfg.embed_dim)
+    params["quant_conv"] = conv(1, cfg.z_channels, cfg.embed_dim)
+    if cfg.is_gumbel:
+        params["quant_proj"] = conv(1, cfg.z_channels, cfg.n_embed)
     params["post_quant_conv"] = conv(1, cfg.embed_dim, cfg.z_channels)
     params["dec_conv_in"] = conv(3, cfg.z_channels, widths[-1])
     cin = widths[-1]

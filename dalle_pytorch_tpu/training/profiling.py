@@ -65,7 +65,7 @@ def _attn_live_density(cfg) -> float:
     import numpy as np
 
     from dalle_pytorch_tpu.models.transformer import (
-        _pattern_for, _pattern_seed, derive_layer_specs,
+        _pattern_for, _pattern_key, derive_layer_specs,
     )
 
     tcfg = cfg.transformer_config() if hasattr(cfg, "transformer_config") else cfg
@@ -74,9 +74,9 @@ def _attn_live_density(cfg) -> float:
     cache: dict = {}
     dens = []
     for spec in derive_layer_specs(tcfg):
-        key = (spec.attn_type, _pattern_seed(spec) if spec.attn_type == "sparse" else 0)
+        key = _pattern_key(spec)
         if key not in cache:
-            pm = _pattern_for(tcfg, spec.attn_type, key[1])
+            pm = _pattern_for(tcfg, key[0], key[1])
             if pm is None:
                 cache[key] = tri_mean
             else:

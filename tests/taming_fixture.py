@@ -62,6 +62,10 @@ def make_taming_state_dict(cfg, rng=None):
             put_conv(f"decoder.up.{lvl}.upsample.conv", w, w, 3)
     put_gn("decoder.norm_out", cin)
     put_conv("decoder.conv_out", cin, cfg.out_ch, 3)
-    key = "quantize.embed.weight" if cfg.is_gumbel else "quantize.embedding.weight"
-    state[key] = rng.randn(cfg.n_embed, cfg.embed_dim).astype(np.float32)
+    if cfg.is_gumbel:
+        state["quantize.embed.weight"] = rng.randn(cfg.n_embed, cfg.embed_dim).astype(np.float32)
+        # GumbelQuantize's own logits projection (applied after quant_conv)
+        put_conv("quantize.proj", cfg.z_channels, cfg.n_embed, 1)
+    else:
+        state["quantize.embedding.weight"] = rng.randn(cfg.n_embed, cfg.embed_dim).astype(np.float32)
     return state

@@ -1,0 +1,323 @@
+"""Ground-truth torch re-statements of the pretrained-VAE architectures.
+
+The reference delegates these models to published implementations
+(/root/reference/dalle_pytorch/vae.py:111-143 loads OpenAI's dVAE pickles,
+:160-229 loads taming-transformers VQModel/GumbelVQ).  The JAX ports
+(models/vqgan.py, models/openai_vae.py) re-implement them; since the
+published weights aren't downloadable offline, these minimal torch
+re-statements of the SAME public architectures are the parity oracle: build
+one with random init, export its state_dict through the real converters, and
+the JAX forward must match the torch forward.
+
+Eval-mode only (no losses, no dropout activity, no training machinery).
+"""
+from collections import OrderedDict
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+# ---------------------------------------------------------------------------
+# taming-transformers VQModel / GumbelVQ (taming/modules/diffusionmodules/
+# model.py + taming/models/vqgan.py architecture)
+# ---------------------------------------------------------------------------
+
+def _normalize(c):
+    return nn.GroupNorm(num_groups=min(32, c), num_channels=c, eps=1e-6, affine=True)
+
+
+def _swish(x):
+    return x * torch.sigmoid(x)
+
+
+class ResnetBlock(nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm1 = _normalize(cin)
+        self.conv1 = nn.Conv2d(cin, cout, 3, 1, 1)
+        self.norm2 = _normalize(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1)
+        if cin != cout:
+            self.nin_shortcut = nn.Conv2d(cin, cout, 1, 1, 0)
+
+    def forward(self, x):
+        h = self.conv1(_swish(self.norm1(x)))
+        h = self.conv2(_swish(self.norm2(h)))
+        if hasattr(self, "nin_shortcut"):
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class AttnBlock(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.norm = _normalize(c)
+        self.q = nn.Conv2d(c, c, 1)
+        self.k = nn.Conv2d(c, c, 1)
+        self.v = nn.Conv2d(c, c, 1)
+        self.proj_out = nn.Conv2d(c, c, 1)
+
+    def forward(self, x):
+        h = self.norm(x)
+        q, k, v = self.q(h), self.k(h), self.v(h)
+        b, c, hh, ww = q.shape
+        q = q.reshape(b, c, hh * ww).permute(0, 2, 1)
+        k = k.reshape(b, c, hh * ww)
+        w = torch.softmax(torch.bmm(q, k) * (c ** -0.5), dim=2)
+        v = v.reshape(b, c, hh * ww)
+        h = torch.bmm(v, w.permute(0, 2, 1)).reshape(b, c, hh, ww)
+        return x + self.proj_out(h)
+
+
+class Downsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class Upsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, 1, 1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class TamingEncoder(nn.Module):
+    def __init__(self, cfg):
+        super().__init__()
+        widths = [cfg.ch * m for m in cfg.ch_mult]
+        self.conv_in = nn.Conv2d(cfg.in_channels, cfg.ch, 3, 1, 1)
+        self.down = nn.ModuleList()
+        cin, res = cfg.ch, cfg.resolution
+        for lvl, w in enumerate(widths):
+            level = nn.Module()
+            level.block = nn.ModuleList()
+            level.attn = nn.ModuleList()
+            for _ in range(cfg.num_res_blocks):
+                level.block.append(ResnetBlock(cin, w))
+                cin = w
+                if res in cfg.attn_resolutions:
+                    level.attn.append(AttnBlock(w))
+            if lvl != len(widths) - 1:
+                level.downsample = Downsample(w)
+                res //= 2
+            self.down.append(level)
+        self.mid = nn.Module()
+        self.mid.block_1 = ResnetBlock(cin, cin)
+        self.mid.attn_1 = AttnBlock(cin)
+        self.mid.block_2 = ResnetBlock(cin, cin)
+        self.norm_out = _normalize(cin)
+        self.conv_out = nn.Conv2d(cin, cfg.z_channels, 3, 1, 1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        for lvl, level in enumerate(self.down):
+            for i, blk in enumerate(level.block):
+                h = blk(h)
+                if len(level.attn) > 0:
+                    h = level.attn[i](h)
+            if hasattr(level, "downsample"):
+                h = level.downsample(h)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        return self.conv_out(_swish(self.norm_out(h)))
+
+
+class TamingDecoder(nn.Module):
+    def __init__(self, cfg):
+        super().__init__()
+        widths = [cfg.ch * m for m in cfg.ch_mult]
+        levels = len(widths)
+        cin = widths[-1]
+        self.conv_in = nn.Conv2d(cfg.z_channels, cin, 3, 1, 1)
+        self.mid = nn.Module()
+        self.mid.block_1 = ResnetBlock(cin, cin)
+        self.mid.attn_1 = AttnBlock(cin)
+        self.mid.block_2 = ResnetBlock(cin, cin)
+        self.up = nn.ModuleList([nn.Module() for _ in range(levels)])
+        curr_res = cfg.resolution // 2 ** (levels - 1)
+        for lvl in reversed(range(levels)):
+            w = widths[lvl]
+            level = self.up[lvl]
+            level.block = nn.ModuleList()
+            level.attn = nn.ModuleList()
+            for _ in range(cfg.num_res_blocks + 1):
+                level.block.append(ResnetBlock(cin, w))
+                cin = w
+                if curr_res in cfg.attn_resolutions:
+                    level.attn.append(AttnBlock(w))
+            if lvl != 0:
+                level.upsample = Upsample(w)
+                curr_res *= 2
+        self.norm_out = _normalize(cin)
+        self.conv_out = nn.Conv2d(cin, cfg.out_ch, 3, 1, 1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        for lvl in reversed(range(len(self.up))):
+            level = self.up[lvl]
+            for i, blk in enumerate(level.block):
+                h = blk(h)
+                if len(level.attn) > 0:
+                    h = level.attn[i](h)
+            if hasattr(level, "upsample"):
+                h = level.upsample(h)
+        return self.conv_out(_swish(self.norm_out(h)))
+
+
+class VectorQuantizerRef(nn.Module):
+    """taming VectorQuantizer, eval path: nearest codebook entry."""
+
+    def __init__(self, n_e, e_dim):
+        super().__init__()
+        self.embedding = nn.Embedding(n_e, e_dim)
+
+    def forward(self, z):  # z: (b, c, h, w)
+        zp = z.permute(0, 2, 3, 1).contiguous()
+        flat = zp.view(-1, zp.shape[-1])
+        d = (
+            flat.pow(2).sum(1, keepdim=True)
+            - 2 * flat @ self.embedding.weight.t()
+            + self.embedding.weight.pow(2).sum(1)[None]
+        )
+        indices = torch.argmin(d, dim=1)
+        z_q = self.embedding(indices).view(zp.shape).permute(0, 3, 1, 2)
+        return z_q, None, (None, None, indices)  # indices flat (b*h*w,)
+
+
+class GumbelQuantizeRef(nn.Module):
+    """taming GumbelQuantize, eval (hard) path: argmax of proj logits."""
+
+    def __init__(self, num_hiddens, embedding_dim, n_embed):
+        super().__init__()
+        self.proj = nn.Conv2d(num_hiddens, n_embed, 1)
+        self.embed = nn.Embedding(n_embed, embedding_dim)
+
+    def forward(self, z):
+        logits = self.proj(z)
+        indices = logits.argmax(dim=1)  # (b, h, w)
+        z_q = self.embed(indices).permute(0, 3, 1, 2)
+        return z_q, None, (None, None, indices)
+
+
+class VQModelRef(nn.Module):
+    def __init__(self, cfg):
+        super().__init__()
+        self.encoder = TamingEncoder(cfg)
+        self.decoder = TamingDecoder(cfg)
+        self.quantize = VectorQuantizerRef(cfg.n_embed, cfg.embed_dim)
+        self.quant_conv = nn.Conv2d(cfg.z_channels, cfg.embed_dim, 1)
+        self.post_quant_conv = nn.Conv2d(cfg.embed_dim, cfg.z_channels, 1)
+
+    def encode(self, x):
+        h = self.quant_conv(self.encoder(x))
+        return self.quantize(h)
+
+    def decode(self, z):
+        return self.decoder(self.post_quant_conv(z))
+
+
+class GumbelVQRef(VQModelRef):
+    def __init__(self, cfg):
+        assert cfg.embed_dim == cfg.z_channels, (
+            "published GumbelVQ configs have embed_dim == z_channels (the "
+            "quant_conv -> quantize.proj chain relies on it)"
+        )
+        super().__init__(cfg)
+        self.quantize = GumbelQuantizeRef(cfg.z_channels, cfg.embed_dim, cfg.n_embed)
+
+
+# ---------------------------------------------------------------------------
+# OpenAI DALL-E dVAE (the published dall_e package architecture: custom Conv2d
+# storing parameters as .w/.b, EncoderBlock/DecoderBlock with 4-conv res
+# paths, maxpool down / nearest up)
+# ---------------------------------------------------------------------------
+
+class DalleConv2d(nn.Module):
+    """The dall_e package's Conv2d: parameters named w and b."""
+
+    def __init__(self, n_in, n_out, kw):
+        super().__init__()
+        self.w = nn.Parameter(torch.randn(n_out, n_in, kw, kw) * (n_in * kw * kw) ** -0.5)
+        self.b = nn.Parameter(torch.zeros(n_out))
+        self.kw = kw
+
+    def forward(self, x):
+        return F.conv2d(x, self.w, self.b, padding=(self.kw - 1) // 2)
+
+
+class DalleEncoderBlock(nn.Module):
+    def __init__(self, n_in, n_out):
+        super().__init__()
+        hid = n_out // 4
+        self.id_path = DalleConv2d(n_in, n_out, 1) if n_in != n_out else nn.Identity()
+        self.res_path = nn.Sequential(OrderedDict([
+            ("relu_1", nn.ReLU()), ("conv_1", DalleConv2d(n_in, hid, 3)),
+            ("relu_2", nn.ReLU()), ("conv_2", DalleConv2d(hid, hid, 3)),
+            ("relu_3", nn.ReLU()), ("conv_3", DalleConv2d(hid, hid, 3)),
+            ("relu_4", nn.ReLU()), ("conv_4", DalleConv2d(hid, n_out, 1)),
+        ]))
+
+    def forward(self, x):
+        return self.id_path(x) + self.res_path(x)
+
+
+def _dalle_half(widths, in_ch, out_ch, k_in, n_blk, pool, first_width=None):
+    """Shared encoder/decoder skeleton: input conv, 4 groups of blocks with
+    down/up-sampling after groups 1-3, relu + 1x1 output conv.  first_width
+    is the input conv's output width (the decoder's n_init != widths[0], so
+    its group_1.block_1 carries an id_path conv)."""
+    first = widths[0] if first_width is None else first_width
+    groups = []
+    cin = first
+    for g, w in enumerate(widths):
+        blocks = [(f"block_{i + 1}", DalleEncoderBlock(cin if i == 0 else w, w))
+                  for i in range(n_blk)]
+        cin = w
+        layers = OrderedDict(blocks)
+        if g < len(widths) - 1:
+            layers["pool" if pool else "upsample"] = (
+                nn.MaxPool2d(2) if pool else nn.Upsample(scale_factor=2, mode="nearest")
+            )
+        groups.append((f"group_{g + 1}", nn.Sequential(layers)))
+    return nn.Sequential(OrderedDict([
+        ("input", DalleConv2d(in_ch, first, k_in)),
+        *groups,
+        ("output", nn.Sequential(OrderedDict([
+            ("relu", nn.ReLU()), ("conv", DalleConv2d(widths[-1], out_ch, 1)),
+        ]))),
+    ]))
+
+
+class DalleEncoderRef(nn.Module):
+    """dall_e Encoder: 7x7 input conv, 4 groups (1,2,4,8)*n_hid, maxpools."""
+
+    def __init__(self, n_hid=256, vocab=8192, n_blk=2, in_ch=3):
+        super().__init__()
+        self.blocks = _dalle_half(
+            [n_hid, 2 * n_hid, 4 * n_hid, 8 * n_hid], in_ch, vocab, 7, n_blk, pool=True
+        )
+
+    def forward(self, x):
+        return self.blocks(x)
+
+
+class DalleDecoderRef(nn.Module):
+    """dall_e Decoder: 1x1 input conv vocab -> n_init, groups (8,4,2,1)*n_hid
+    with nearest-neighbour upsampling, 6-channel (logit-laplace) output."""
+
+    def __init__(self, n_hid=256, vocab=8192, n_blk=2, out_ch=6, n_init=128):
+        super().__init__()
+        self.blocks = _dalle_half(
+            [8 * n_hid, 4 * n_hid, 2 * n_hid, n_hid], vocab, out_ch, 1, n_blk,
+            pool=False, first_width=n_init,
+        )
+
+    def forward(self, z):
+        return self.blocks(z)
