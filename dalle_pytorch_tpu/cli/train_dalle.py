@@ -76,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--attn_dropout", type=float, default=0.0)
     parser.add_argument("--ff_dropout", type=float, default=0.0)
     parser.add_argument("--execution", type=str, default=None, choices=[None, "sequential", "remat", "reversible"])
+    parser.add_argument("--scan_layers", action="store_true",
+                        help="lax.scan over stacked layers (near-constant compile time in depth)")
+    parser.add_argument("--remat_policy", type=str, default="full",
+                        choices=["full", "flash", "flash_qkv", "flash_qkv_ff"],
+                        help="selective remat save policy for --execution remat")
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-separated cycle of full,axial_row,axial_col,conv_like,sparse")
@@ -95,6 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
     parser.add_argument("--lr_decay", action="store_true")
     parser.add_argument("--sample_every_n_steps", type=int, default=100)
+    parser.add_argument("--log_every_n_steps", type=int, default=10,
+                        help="loss/throughput logging cadence (reference logs every 10 iters)")
     parser.add_argument("--num_workers", type=int, default=4,
                         help="decode/crop worker threads (0 = load in the training loop)")
     parser.add_argument("--prefetch_batches", type=int, default=2,
@@ -260,6 +267,8 @@ def main(argv=None):
             attn_dropout=args.attn_dropout,
             ff_dropout=args.ff_dropout,
             execution=args.execution,
+            scan_layers=args.scan_layers,
+            remat_policy=args.remat_policy,
             loss_img_weight=args.loss_img_weight,
             attn_types=tuple(args.attn_types.split(",")),
             stable=args.stable_softmax,
@@ -360,9 +369,12 @@ def main(argv=None):
     # global step through the DeepSpeed engine, train_dalle.py:531-532)
     global_step = (resume_meta or {}).get("global_step", 0) or 0
 
-    def save(path, epoch, keep_n=None):
+    def save(path, epoch, keep_n=None, step=None):
+        # `step` is the NEXT step to run after resume; mid-loop callers pass
+        # global_step + 1 (the increment happens at loop end)
         save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
-                   keep_n=keep_n, global_step=global_step,
+                   keep_n=keep_n,
+                   global_step=global_step if step is None else step,
                    wandb_run_id=logger.run_id)
 
     # save-before-train fail-fast (reference train_dalle.py:591-594)
@@ -370,6 +382,7 @@ def main(argv=None):
         save(out_file, start_epoch)
 
     key = jax.random.PRNGKey(args.seed + 1)
+    first_window = True
     for epoch in range(start_epoch, args.epochs):
         t_window = time.time()
         window_start = global_step  # reset with t_window: a stale window
@@ -388,20 +401,23 @@ def main(argv=None):
             }
             state, metrics = step_fn(state, device_batch, sk)
 
-            if global_step % 10 == 0:
+            if global_step % args.log_every_n_steps == 0:
                 dt = time.time() - t_window
                 steps_done = global_step - window_start + 1
-                sample_per_sec = args.batch_size * steps_done / max(dt, 1e-9)
+                record = {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch}
+                if not first_window:
+                    # the process's first window spans jit compilation —
+                    # minutes for billion-parameter configs — so its rate
+                    # is not a throughput measurement
+                    record["sample_per_sec"] = args.batch_size * steps_done / max(dt, 1e-9)
+                first_window = False
                 t_window = time.time()
                 window_start = global_step + 1
-                logger.log(
-                    {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch,
-                     "sample_per_sec": sample_per_sec},
-                    step=global_step,
-                )
+                logger.log(record, step=global_step)
             if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and is_root:
                 step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
-                save(step_file, epoch, keep_n=args.keep_n_checkpoints)
+                save(step_file, epoch, keep_n=args.keep_n_checkpoints,
+                     step=global_step + 1)
             if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
                 _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
             if args.flops_profiler:

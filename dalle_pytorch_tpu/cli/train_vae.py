@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches
+from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches, prefetch_to_device
 from dalle_pytorch_tpu.models import vae as vae_mod
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num_images_save", type=int, default=4)
     parser.add_argument("--vae_output_file_name", type=str, default="vae")
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    parser.add_argument("--num_workers", type=int, default=4,
+                        help="decode/crop worker threads (0 = load in the training loop)")
+    parser.add_argument("--prefetch_batches", type=int, default=2,
+                        help="device-side prefetch depth (0 disables async transfer)")
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     parser.add_argument("--wandb_name", type=str, default="dalle_train_vae")
@@ -134,10 +138,14 @@ def main(argv=None):
     key = jax.random.PRNGKey(args.seed + 1)
     for epoch in range(args.epochs):
         t0 = time.time()
-        for images in iterate_image_batches(
+        batches = iterate_image_batches(
             dataset, args.batch_size, seed=args.seed + epoch,
             process_index=be.get_rank(), process_count=be.get_world_size(),
-        ):
+            num_workers=args.num_workers,
+        )
+        if args.prefetch_batches > 0:
+            batches = prefetch_to_device(batches, size=args.prefetch_batches)
+        for images in batches:
             key, sk = jax.random.split(key)
             params, opt_state, loss = train_step(
                 params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr)

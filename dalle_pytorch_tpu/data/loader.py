@@ -289,10 +289,13 @@ class ImageDataset:
     def __len__(self) -> int:
         return len(self.files)
 
-    def __getitem__(self, ind: int) -> np.ndarray:
+    def get(self, ind: int, rng: random.Random) -> np.ndarray:
         img = Image.open(self.files[ind])
-        img = random_resized_crop(img.convert(self.mode), self.image_size, self._rng)
+        img = random_resized_crop(img.convert(self.mode), self.image_size, rng)
         return _image_to_array(img, self.mode)
+
+    def __getitem__(self, ind: int) -> np.ndarray:
+        return self.get(ind, self._rng)
 
 
 def iterate_image_batches(
@@ -302,14 +305,27 @@ def iterate_image_batches(
     seed: int = 0,
     process_index: int = 0,
     process_count: int = 1,
+    num_workers: int = 0,
 ) -> Iterator[np.ndarray]:
     n = len(dataset)
     order = np.arange(n)
     if shuffle:
         np.random.RandomState(seed).shuffle(order)
     order = order[process_index::process_count]
-    for i in range(0, len(order) - batch_size + 1, batch_size):
-        yield np.stack([dataset[int(j)] for j in order[i : i + batch_size]])
+    order = order[: len(order) - len(order) % batch_size]
+    if not len(order):
+        return
+
+    def load(j):
+        return dataset.get(int(j), _item_rng(seed, 0, int(j)))
+
+    items = _parallel_map_ordered(load, order, num_workers, lookahead=2 * batch_size)
+    batch: List[np.ndarray] = []
+    for item in items:
+        batch.append(item)
+        if len(batch) == batch_size:
+            yield np.stack(batch)
+            batch = []
 
 
 # --- tar-shard (webdataset-style) pipeline ---------------------------------

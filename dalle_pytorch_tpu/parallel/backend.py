@@ -157,6 +157,11 @@ class JaxBackend(DistributedBackend):
     def _initialize(self):
         coord, num, pid = self._coord
         if coord is not None:
+            if (num is None) != (pid is None):
+                raise ValueError(
+                    "--num_processes and --process_id must be given together "
+                    "(or both omitted for TPU-pod auto-detection)"
+                )
             jax.distributed.initialize(coord, num, pid)
         elif jax.process_count() == 1 and _tpu_pod_env():
             jax.distributed.initialize()

@@ -120,23 +120,24 @@ def make_train_step(
 
     def step_fn_inner(state: TrainState, batch, key):
         grads, loss = grads_and_loss(state.params, batch, key)
+        # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
+        # no f32 copy of the gradient buffer is materialized)
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(grads)
+        ))
         if settings.clip_grad_norm is not None:
-            # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
-            # no f32 copy of the gradient buffer is materialized)
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree_util.tree_leaves(grads)
-            ))
             factor = jnp.minimum(1.0, settings.clip_grad_norm / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(
                 lambda g: g * factor.astype(g.dtype), grads
             )
+            gnorm = gnorm * factor  # the metric reports the applied norm
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params, value=loss
         )
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(state.step + 1, params, opt_state)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        metrics = {"loss": loss, "grad_norm": gnorm}
         return new_state, metrics
 
     if mesh is None:

@@ -7,6 +7,11 @@ live config are forced here."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests never use the TPU tunnel; leaving the axon PJRT plugin registered
+# makes every test process block on the tunnel's health (its registration
+# dials the relay even when the cpu platform is selected).  Clearing the
+# pool address makes the sitecustomize hook skip registration entirely.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
