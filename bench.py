@@ -117,12 +117,18 @@ def main():
         sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1
     )
 
-    # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same model
-    gen_s_per_image = None
+    # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same
+    # model; plus the FULL generate-images pipeline (codes -> VAE decode ->
+    # CLIP scores), the generate.py-with-rerank path the BASELINE row names
+    gen_s_per_image = gen_full_s_per_image = None
     gen_batch = 8
     if on_tpu:
         from dalle_pytorch_tpu.core.pytree import cast_floating
-        from dalle_pytorch_tpu.models.sampling import sample_image_codes
+        from dalle_pytorch_tpu.models import clip as clip_mod
+        from dalle_pytorch_tpu.models import vae as vae_mod
+        from dalle_pytorch_tpu.models.clip import CLIPConfig
+        from dalle_pytorch_tpu.models.sampling import generate_images, sample_image_codes
+        from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 
         gen_params = cast_floating(state.params, jnp.bfloat16)  # deployment dtype
         text = jax.random.randint(jax.random.PRNGKey(5), (gen_batch, cfg.text_seq_len), 1, cfg.num_text_tokens)
@@ -132,6 +138,30 @@ def main():
         codes = sample_image_codes(gen_params, cfg, text, jax.random.PRNGKey(7))
         int(codes[0, 0])
         gen_s_per_image = (time.perf_counter() - t0) / gen_batch
+
+        # full pipeline: dVAE decode (8192 codes, 32x32 fmap, 128px) + CLIP
+        # rerank — random weights; wall-clock depends on architecture only
+        vcfg = DiscreteVAEConfig(image_size=128, num_tokens=cfg.num_image_tokens,
+                                 codebook_dim=256, num_layers=2, hidden_dim=64)
+        vparams = cast_floating(vae_mod.init_discrete_vae(jax.random.PRNGKey(8), vcfg), jnp.bfloat16)
+        ccfg = CLIPConfig(num_text_tokens=cfg.num_text_tokens, text_seq_len=cfg.text_seq_len,
+                          visual_image_size=128, visual_patch_size=16)
+        cparams = cast_floating(clip_mod.init_clip(jax.random.PRNGKey(9), ccfg), jnp.bfloat16)
+
+        @jax.jit
+        def full_gen(key):
+            images, scores = generate_images(
+                gen_params, cfg, vparams, vcfg, text, key,
+                clip_params=cparams, clip_cfg=ccfg,
+            )
+            return images, scores
+
+        images, scores = full_gen(jax.random.PRNGKey(10))
+        float(scores[0])  # force
+        t0 = time.perf_counter()
+        images, scores = full_gen(jax.random.PRNGKey(11))
+        float(scores[0])
+        gen_full_s_per_image = (time.perf_counter() - t0) / gen_batch
 
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
@@ -186,11 +216,22 @@ def main():
 
     flagship = flagship_1p7b = None
     if on_tpu:
-        del state, gen_params, codes, text  # free HBM for the billion-param models
+        # free HBM for the billion-param models: drop locals AND the jitted
+        # closures/executables that embed them as constants (full_gen holds
+        # the whole bf16 model otherwise)
+        del state, gen_params, codes, text, vparams, cparams, images, scores, full_gen
+        jax.clear_caches()
+
+        def try_flagship(*a, **kw):
+            try:
+                return run_flagship(*a, **kw)
+            except Exception as e:  # a failed flagship row must not kill the bench line
+                return {"error": repr(e)[:200]}
+
         # true 1.3B at depth 64: dim 1152, 8 heads x 128 (inner 1024)
-        flagship = run_flagship(1152, 8, 128, fbatch=8)
+        flagship = try_flagship(1152, 8, 128, fbatch=8)
         # round-1/2 continuity row: the 1.70B dim-1280 stand-in
-        flagship_1p7b = run_flagship(1280, 10, 128, fbatch=4)
+        flagship_1p7b = try_flagship(1280, 10, 128, fbatch=4)
 
     print(json.dumps({
         "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280)" if on_tpu
@@ -204,6 +245,9 @@ def main():
         "batch": batch,
         "loss": final_loss,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
+        "gen_full_pipeline_seconds_per_image": (
+            round(gen_full_s_per_image, 3) if gen_full_s_per_image else None
+        ),
         "flagship_1p3b_depth64": flagship,
         "flagship_1p7b_dim1280": flagship_1p7b,
         "backend": jax.default_backend(),

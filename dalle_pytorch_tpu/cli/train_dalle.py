@@ -317,9 +317,23 @@ def main(argv=None):
                 num_workers=args.num_workers,
             )
 
-    # loss: raw pixels -> frozen VAE codes -> DALLE CE loss
+    use_bf16 = args.bf16 or args.fp16 or args.amp
+
+    # loss: raw pixels -> frozen VAE codes -> DALLE CE loss.  The frozen
+    # VAE's conv encode runs in the compute dtype too — it only produces
+    # argmax code ids, and f32 convs would otherwise dominate the host of a
+    # bf16 step on real data
+    from dalle_pytorch_tpu.core.pytree import cast_floating
+
+    encode_vae_params = (
+        cast_floating(vae_params, jnp.bfloat16) if use_bf16 else vae_params
+    )
+
     def loss_fn(params, batch, key):
-        codes = vae_registry.get_codebook_indices(vae_params, vae_cfg, batch["image"])
+        image = batch["image"]
+        if use_bf16:
+            image = image.astype(jnp.bfloat16)
+        codes = vae_registry.get_codebook_indices(encode_vae_params, vae_cfg, image)
         return dalle_mod.forward(
             params, dalle_cfg, batch["text"], jax.lax.stop_gradient(codes),
             return_loss=True, key=key,
@@ -335,7 +349,6 @@ def main(argv=None):
                 factor=0.5, patience=10, cooldown=10, min_scale=1e-6 / args.learning_rate
             ),
         )
-    use_bf16 = args.bf16 or args.fp16 or args.amp
     if (args.fp16 or args.amp) and is_root:
         print("note: --fp16/--amp map to bf16 on TPU (no loss scaling needed)")
     settings = StepSettings(

@@ -566,45 +566,16 @@ def apply_transformer(
     return x
 
 
-def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rotary):
-    """lax.scan over stacked per-layer params.  Per-layer attention patterns
-    become a traced select from a stacked mask array (with stacked Pallas
-    tile-liveness tables, so block skipping survives the scan)."""
-    import numpy as np
-
+def _assert_scannable(cfg, specs):
     assert cfg.execution in ("sequential", "remat"), "scan_layers: sequential/remat only"
     assert len({s.attn_id for s in specs}) == cfg.depth and len({s.ff_id for s in specs}) == cfg.depth, (
         "scan_layers requires unshared layers (shared_attn_ids/shared_ff_ids unset)"
     )
-    n = x.shape[1]
 
-    from dalle_pytorch_tpu.kernels.flash_attention import (
-        DEFAULT_BLOCK_K,
-        DEFAULT_BLOCK_Q,
-        resolve_block,
-    )
 
-    distinct = list(dict.fromkeys(_pattern_key(s) for s in specs))
-    masks_np, lives_np = [], []
-    # liveness granularity must match the kernel's RESOLVED block sizes
-    try:
-        bq = resolve_block(n, DEFAULT_BLOCK_Q)
-        bk = resolve_block(n, DEFAULT_BLOCK_K)
-        derive_live = True
-    except ValueError:  # no valid block: the flash path won't be taken anyway
-        derive_live = False
-    for t, seed in distinct:
-        pm = _pattern_for(cfg, t, seed)
-        m = np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n]
-        masks_np.append(m)
-        if derive_live:
-            lives_np.append(
-                m.reshape(n // bq, bq, n // bk, bk).any(axis=(1, 3)).astype(np.int32)
-            )
-    masks = jnp.asarray(np.stack(masks_np))
-    lives = jnp.asarray(np.stack(lives_np)) if derive_live else None
-    midx = jnp.asarray([distinct.index(_pattern_key(s)) for s in specs], jnp.int32)
-
+def _stacked_bundles(params, specs):
+    """Per-layer param bundles stacked along a leading depth axis (the
+    lax.scan xs for every scan-layers path: training, prefill, decode)."""
     bundles = [
         {
             "attn": params["shared_attn"][s.attn_id],
@@ -613,7 +584,52 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
         }
         for s in specs
     ]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *bundles)
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *bundles)
+
+
+def _stacked_masks(cfg, specs, n: int):
+    """(masks (D, n, n) bool, midx (depth,) int32): one mask per DISTINCT
+    pattern ('full' becomes all-ones), selected per layer by traced index."""
+    import numpy as np
+
+    distinct = list(dict.fromkeys(_pattern_key(s) for s in specs))
+    masks_np = []
+    for t, seed in distinct:
+        pm = _pattern_for(cfg, t, seed)
+        masks_np.append(np.ones((n, n), bool) if pm is None else np.asarray(pm)[:n, :n])
+    midx = jnp.asarray([distinct.index(_pattern_key(s)) for s in specs], jnp.int32)
+    return np.stack(masks_np), midx
+
+
+def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rotary):
+    """lax.scan over stacked per-layer params.  Per-layer attention patterns
+    become a traced select from a stacked mask array (with stacked Pallas
+    tile-liveness tables, so block skipping survives the scan)."""
+    import numpy as np
+
+    _assert_scannable(cfg, specs)
+    n = x.shape[1]
+
+    from dalle_pytorch_tpu.kernels.flash_attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        resolve_block,
+    )
+
+    masks_np, midx = _stacked_masks(cfg, specs, n)
+    # liveness granularity must match the kernel's RESOLVED block sizes
+    try:
+        bq = resolve_block(n, DEFAULT_BLOCK_Q)
+        bk = resolve_block(n, DEFAULT_BLOCK_K)
+        lives = jnp.asarray(np.stack([
+            m.reshape(n // bq, bq, n // bk, bk).any(axis=(1, 3)).astype(np.int32)
+            for m in masks_np
+        ]))
+    except ValueError:  # no valid block: the flash path won't be taken anyway
+        lives = None
+    masks = jnp.asarray(masks_np)
+
+    stacked = _stacked_bundles(params, specs)
 
     def run_branch(bundle, h, kind, mask, live, dkey):
         out, _ = _residual_branch(
@@ -650,19 +666,26 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
 
 def init_cache(cfg: TransformerConfig, batch: int, dtype=jnp.float32) -> dict:
     """Fixed-shape KV cache + token-shift ring buffers; `offset` is the number
-    of positions already consumed."""
-    layers = []
-    for spec in derive_layer_specs(cfg):
-        entry = {
-            "k": jnp.zeros((batch, cfg.heads, cfg.seq_len, cfg.dim_head), dtype),
-            "v": jnp.zeros((batch, cfg.heads, cfg.seq_len, cfg.dim_head), dtype),
+    of positions already consumed.  With cfg.scan_layers the per-layer entries
+    are stacked along a leading depth axis (the scan-layers cached paths scan
+    over them) instead of held in a python list."""
+
+    def entry(lead=()):
+        e = {
+            "k": jnp.zeros((*lead, batch, cfg.heads, cfg.seq_len, cfg.dim_head), dtype),
+            "v": jnp.zeros((*lead, batch, cfg.heads, cfg.seq_len, cfg.dim_head), dtype),
         }
         if cfg.shift_tokens:
             q = cfg.dim // 4
             fmap = cfg.image_fmap_size
-            entry["shift_attn"] = jnp.zeros((batch, fmap, 2, q), dtype)
-            entry["shift_ff"] = jnp.zeros((batch, fmap, 2, q), dtype)
-        layers.append(entry)
+            e["shift_attn"] = jnp.zeros((*lead, batch, fmap, 2, q), dtype)
+            e["shift_ff"] = jnp.zeros((*lead, batch, fmap, 2, q), dtype)
+        return e
+
+    if cfg.scan_layers:
+        layers = entry(lead=(cfg.depth,))
+    else:
+        layers = [entry() for _ in derive_layer_specs(cfg)]
     return {"offset": jnp.zeros((), jnp.int32), "layers": layers}
 
 
@@ -742,6 +765,36 @@ def _run_cached_layers(cfg: TransformerConfig, specs, x, cache, branch):
     return h, new_layers
 
 
+def _run_cached_scan(params, cfg, specs, x, cache, mode, rotary, key_mask=None,
+                     text_only=False):
+    """Scan-layers version of the cached paths: one lax.scan over stacked
+    params + stacked cache entries, per-layer pattern selected by traced
+    index.  Returns (out, stacked new layer caches)."""
+    _assert_scannable(cfg, specs)
+    offset = cache["offset"]
+    masks_np, midx = _stacked_masks(cfg, specs, cfg.seq_len)
+    masks = jnp.asarray(masks_np)
+    stacked = _stacked_bundles(params, specs)
+
+    def body(h, xs):
+        bundle, mi, lc = xs
+        mask = jnp.take(masks, mi, axis=0)
+        fa, lc = _residual_branch(
+            cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "attn",
+            mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
+            layer_cache=lc, offset=offset, text_mode=text_only,
+        )
+        h = h + fa
+        fb, lc = _residual_branch(
+            cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "ff",
+            mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
+            layer_cache=lc, offset=offset, text_mode=text_only,
+        )
+        return h + fb, lc
+
+    return jax.lax.scan(body, x, (stacked, midx, cache["layers"]))
+
+
 def decode_step(
     params: dict,
     cfg: TransformerConfig,
@@ -755,8 +808,15 @@ def decode_step(
     (generate_texts) — the token shift is skipped (identity there)."""
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
-    patterns = spec_patterns(cfg, specs)
     offset = cache["offset"]
+
+    if cfg.scan_layers:
+        out, new_layers = _run_cached_scan(
+            params, cfg, specs, x, cache, "decode", rotary, text_only=text_only
+        )
+        return out, {"offset": offset + 1, "layers": new_layers}
+
+    patterns = spec_patterns(cfg, specs)
 
     def branch(spec, x, kind, layer_cache):
         return _residual_branch(
@@ -782,6 +842,13 @@ def prefill(
     n = x.shape[1]
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
+
+    if cfg.scan_layers:
+        out, new_layers = _run_cached_scan(
+            params, cfg, specs, x, cache, "prefill", rotary, key_mask=key_mask
+        )
+        return out, {"offset": jnp.asarray(n, jnp.int32), "layers": new_layers}
+
     patterns = spec_patterns(cfg, specs)
 
     def branch(spec, x, kind, layer_cache):

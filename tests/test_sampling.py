@@ -59,6 +59,8 @@ def greedy_oracle(params, cfg, text):
         # PRODUCING position (dalle_pytorch.py:646-652); a text/image length
         # imbalance catches off-by-one row selection the square case hides
         dict(text_seq_len=12, image_fmap_size=3, num_image_tokens=24),
+        # scan-layers cached decode: stacked caches + traced mask select
+        dict(scan_layers=True, attn_types=("full", "axial_row", "conv_like")),
     ],
 )
 def test_greedy_sampling_matches_uncached_oracle(kw):
@@ -175,7 +177,10 @@ def test_generate_texts():
     assert out_default.shape == (1, cfg.text_seq_len)
 
 
-@pytest.mark.parametrize("kw", [dict(), dict(rotary_emb=False), dict(stable=True)])
+@pytest.mark.parametrize(
+    "kw",
+    [dict(), dict(rotary_emb=False), dict(stable=True), dict(scan_layers=True)],
+)
 def test_generate_texts_cached_matches_uncached(kw):
     """The KV-cached path must reproduce the reference-shaped full-re-forward
     loop.  Greedy (tiny temperature + tight top-k) removes tie sensitivity;
