@@ -109,6 +109,24 @@ def test_priming_preserves_primer():
     np.testing.assert_array_equal(out[:, :7], np.asarray(primer))
 
 
+def test_primed_greedy_matches_oracle_scan_layers():
+    """Priming under scan-layers: the stacked-cache prefill must fill the
+    shift ring buffers identically to the per-layer loop."""
+    cfg = tiny_cfg(scan_layers=True)
+    cfg_loop = tiny_cfg()
+    params, text = setup(cfg)
+    primer = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 7)), jnp.int32)
+    a = np.asarray(sample_image_codes(
+        params, cfg_loop, text, jax.random.PRNGKey(9),
+        filter_thres=0.97, temperature=1e-6, primer_codes=primer, prime_len=7,
+    ))
+    b = np.asarray(sample_image_codes(
+        params, cfg, text, jax.random.PRNGKey(9),
+        filter_thres=0.97, temperature=1e-6, primer_codes=primer, prime_len=7,
+    ))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_primed_greedy_matches_oracle():
     """Priming must continue exactly the chain the oracle produces."""
     cfg = tiny_cfg()

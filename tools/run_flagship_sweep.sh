@@ -1,0 +1,29 @@
+#!/bin/bash
+# Ordered flagship sweep on the real chip (run when the tunnel is healthy).
+# Risk-ordered: a small scan+policy graph first (validates the remote
+# compiler handles the selective-remat HLO), then the geometry/policy/batch
+# grid.  Each config is its own process (clean HBM arena); results append to
+# tools/sweep_results.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/sweep_results.jsonl
+run() {
+  echo "--- $*" >&2
+  PYTHONPATH=$PWD:/root/.axon_site timeout 900 python tools/flagship_sweep.py "$@" 2>/dev/null | tail -1 | tee -a "$OUT"
+}
+
+# 0) small graph with the full machinery (policy+scan) — compiler canary
+run --dim 512 --depth 8 --heads 8 --dim_head 64 --batch 8 --policy flash_qkv
+
+# 1) 1.70B continuity geometry at batch 4
+run --policy flash
+run --policy flash_qkv
+run --policy flash_qkv --grad_dtype bfloat16
+run --policy flash_qkv --grad_dtype bfloat16 --batch 8
+
+# 2) true-1.3B geometry (dim 1152, 8x128 heads)
+run --dim 1152 --heads 8 --policy full --grad_dtype bfloat16
+run --dim 1152 --heads 8 --policy flash_qkv --grad_dtype bfloat16
+run --dim 1152 --heads 8 --policy flash_qkv --grad_dtype bfloat16 --batch 8
+run --dim 1152 --heads 8 --policy flash_qkv_ff --grad_dtype bfloat16 --batch 4
+echo "sweep done" >&2
