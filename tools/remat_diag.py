@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Diagnose what each remat policy actually recomputes.
+
+Traces grad(loss) of a tiny flagship-shaped model (scan_layers + remat +
+attn_kernel='flash') and counts, inside the BACKWARD scan body, how many
+times the flash forward kernel and each matmul run.  Pure tracing — runs on
+CPU, no TPU needed.  This answers: does save_only_these_names('flash_out',
+'flash_lse') actually stop the backward from re-running the Pallas forward?
+"""
+from __future__ import annotations
+
+import collections
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def count_eqns(jaxpr, depth=0, counter=None, path=""):
+    """Recursively count primitives in a (closed) jaxpr, descending into
+    call/scan/remat/custom_vjp sub-jaxprs."""
+    if counter is None:
+        counter = collections.Counter()
+    for eqn in jaxpr.eqns:
+        counter[eqn.primitive.name] += 1
+        for v in eqn.params.values():
+            sub = None
+            if hasattr(v, "jaxpr"):
+                sub = v.jaxpr if hasattr(v.jaxpr, "eqns") else v
+            elif hasattr(v, "eqns"):
+                sub = v
+            if sub is not None:
+                count_eqns(sub, depth + 1, counter, path + "/" + eqn.primitive.name)
+        # branches (cond) come as a tuple of closed jaxprs
+        br = eqn.params.get("branches")
+        if br:
+            for b in br:
+                count_eqns(b.jaxpr, depth + 1, counter, path + "/cond")
+    return counter
+
+
+def main():
+    from dalle_pytorch_tpu.models import dalle as dalle_mod
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+
+    policy = sys.argv[1] if len(sys.argv) > 1 else "flash"
+
+    # transformer seq after bos+trim = text_seq_len + 16*16 = 256+256 = 512,
+    # %128 == 0 so the flash path engages
+    cfg = DALLEConfig(
+        dim=128, depth=4, heads=2, dim_head=64,
+        num_text_tokens=300, text_seq_len=256,
+        num_image_tokens=128, image_fmap_size=16,
+        attn_types=("full",),
+        shift_tokens=False, rotary_emb=False,
+        execution="remat", scan_layers=True, remat_policy=policy,
+        attn_kernel="flash",
+    )
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+
+    text = jnp.zeros((2, cfg.text_seq_len), jnp.int32)
+    img = jnp.zeros((2, cfg.image_seq_len), jnp.int32)
+
+    def loss(p):
+        return dalle_mod.forward(p, cfg, text, img, return_loss=True)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss))(params)
+
+    keys = ("pallas_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+            "remat2", "scan", "dot_general", "while")
+
+    # top-level scans: first = forward layer scan, later ones = backward
+    scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
+    print(f"policy={policy}: {len(scans)} top-level scans")
+    for i, s in enumerate(scans):
+        body = s.params["jaxpr"].jaxpr
+        c = count_eqns(body)
+        picked = {k: v for k, v in c.items() if k in keys}
+        n_carry = len(body.invars)
+        print(f"  scan[{i}] (body invars={n_carry}): {dict(sorted(picked.items()))}")
+    total = count_eqns(jaxpr.jaxpr)
+    print(f"  whole-graph: {({k: v for k, v in sorted(total.items()) if k in keys})}")
+
+
+if __name__ == "__main__":
+    main()

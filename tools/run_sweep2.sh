@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-3 sweep #2: batch scaling with the policies that compile
+# (full / flash) — sweep #1 showed flash_qkv/_ff crash or hang the TPU
+# compiler at flagship dims.  Question: how much does M=batch*seq scaling
+# recover MXU utilization at dim 1152/1280?
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/sweep_results.jsonl
+run() {
+  echo "--- $*" >&2
+  PYTHONPATH=$PWD:/root/.axon_site timeout 900 python tools/flagship_sweep.py "$@" 2>/dev/null | tail -1 | tee -a "$OUT"
+}
+
+# dim 1152 (true 1.3B): batch scaling under full remat
+run --dim 1152 --heads 8 --policy full --grad_dtype bfloat16 --batch 8
+run --dim 1152 --heads 8 --policy full --grad_dtype bfloat16 --batch 16
+# flash policy (saves out/lse, compiles fine at 1280 f32): 1152 + batch
+run --dim 1152 --heads 8 --policy flash --grad_dtype bfloat16 --batch 8
+run --dim 1152 --heads 8 --policy flash --grad_dtype bfloat16 --batch 16
+# 1.70B continuity: batch 8 under full/flash
+run --policy full --grad_dtype bfloat16 --batch 8
+run --policy flash --grad_dtype bfloat16 --batch 8
+echo "sweep2 done" >&2
