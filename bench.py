@@ -165,73 +165,72 @@ def main():
 
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
-    # kept as a secondary row for cross-round continuity.  Both run the
-    # axial+conv sparse cycle with scan-layers + SELECTIVE remat (the flash
-    # custom_vjp's out/lse and the qkv projection are saved across the
-    # checkpoint boundary — the backward never re-runs the flash forward),
-    # bf16 gradients, and factored optimizer state (adafactor — f32 Adam
-    # moments for >1.3B exceed one v5e's 16 GB).
-    def run_flagship(dim, heads, dim_head, fbatch, policy="flash_qkv", steps=4):
-        fcfg = DALLEConfig(
-            dim=dim, depth=64, heads=heads, dim_head=dim_head,
-            num_text_tokens=10000, text_seq_len=256,
-            num_image_tokens=8192, image_fmap_size=32,
-            attn_types=("full", "axial_row", "axial_col", "conv_like"),
-            shift_tokens=True, rotary_emb=True, execution="remat", scan_layers=True,
-            remat_policy=policy, share_input_output_emb=True,
-        )
-        fparams = dalle_mod.init_dalle(jax.random.PRNGKey(0), fcfg)
+    # kept as a secondary row for cross-round continuity.  Each row runs as a
+    # SUBPROCESS (tools/flagship_sweep.py) with a hard timeout: a clean HBM
+    # arena per config, and a pathological remote-compile (sweeps showed some
+    # policy/size combos hang the TPU compiler >15 min) degrades that row to
+    # an error instead of hanging the whole bench.
+    def run_flagship(dim, heads, policy, fbatch, param_dtype, timeout_s=840):
+        import os
+        import subprocess
+        import sys
 
-        def floss_fn(p, b, key):
-            return dalle_mod.forward(p, fcfg, b["text"], b["image_codes"], return_loss=True)
-
-        finit, fstep = make_train_step(
-            floss_fn, optax.adafactor(1e-3),
-            settings=StepSettings(compute_dtype=jnp.bfloat16, grad_dtype=jnp.bfloat16),
-        )
-        fstate = finit(fparams)
-        del fparams
-        fbd = {
-            "text": jax.random.randint(jax.random.PRNGKey(1), (fbatch, fcfg.text_seq_len), 0, fcfg.num_text_tokens),
-            "image_codes": jax.random.randint(jax.random.PRNGKey(2), (fbatch, fcfg.image_seq_len), 0, fcfg.num_image_tokens),
-        }
-        for i in range(2):
-            fstate, fm = fstep(fstate, fbd, jax.random.PRNGKey(i))
-        float(fm["loss"])
-        t0 = time.perf_counter()
-        for i in range(steps):
-            fstate, fm = fstep(fstate, fbd, jax.random.PRNGKey(10 + i))
-        floss = float(fm["loss"])
-        fdt = (time.perf_counter() - t0) / steps
-        fflops = dalle_step_flops(fcfg, fbatch, matmul_param_count(fstate.params))
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cmd = [
+            sys.executable, os.path.join(repo, "tools", "flagship_sweep.py"),
+            "--dim", str(dim), "--heads", str(heads), "--dim_head", "128",
+            "--batch", str(fbatch), "--policy", policy,
+            "--grad_dtype", "bfloat16", "--param_dtype", param_dtype,
+        ]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout_s,
+                cwd=repo, env=env,
+            )
+            line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+            row = json.loads(line)
+        except subprocess.TimeoutExpired:
+            return {"error": f"timeout after {timeout_s}s (remote compile hang)"}
+        except Exception as e:
+            # no JSON line (e.g. hard crash): surface the subprocess stderr
+            tail = ""
+            try:
+                tail = " :: " + proc.stderr.strip().splitlines()[-1][:150]
+            except Exception:
+                pass
+            return {"error": (repr(e) + tail)[:300]}
+        if "error" in row:
+            return {"error": row["error"][:200]}
         return {
-            "params_million": round(sum(x.size for x in jax.tree_util.tree_leaves(fstate.params)) / 1e6, 1),
-            "step_time_s": round(fdt, 4),
-            "img_tok_per_sec": round(fbatch * fcfg.image_seq_len / fdt, 1),
-            "mfu": round(fflops / fdt / _chip_peak(), 4),
+            "params_million": row["params_million"],
+            "step_time_s": row["step_time_s"],
+            "img_tok_per_sec": row["img_tok_per_sec"],
+            "mfu": row["mfu"],
             "batch": fbatch,
             "remat_policy": policy,
-            "loss": floss,
+            "param_dtype": param_dtype,
+            "loss": row["loss"],
         }
 
     flagship = flagship_1p7b = None
     if on_tpu:
-        # free HBM for the billion-param models: drop locals AND the jitted
-        # closures/executables that embed them as constants (full_gen holds
-        # the whole bf16 model otherwise)
+        # free this process's HBM so the subprocess gets the full chip: drop
+        # locals AND the jitted closures/executables that embed them as
+        # constants (full_gen holds the whole bf16 model otherwise)
         del state, gen_params, codes, text, vparams, cparams, images, scores, full_gen
         jax.clear_caches()
 
-        def try_flagship(*a, **kw):
-            try:
-                return run_flagship(*a, **kw)
-            except Exception as e:  # a failed flagship row must not kill the bench line
-                return {"error": repr(e)[:200]}
-
-        # true 1.3B at depth 64: dim 1152, 8 heads x 128 (inner 1024)
-        flagship = try_flagship(1152, 8, 128, fbatch=8)
+        # true 1.3B at depth 64: dim 1152, 8 heads x 128 (inner 1024).
+        # pure-bf16 storage (stochastic-rounded updates) + selective remat.
+        flagship = run_flagship(1152, 8, "flash_qkv", fbatch=8, param_dtype="bfloat16")
+        if "error" in flagship:  # fallback: the config proven to compile everywhere
+            fb = run_flagship(1152, 8, "full", fbatch=4, param_dtype="float32")
+            fb["fallback_from"] = flagship["error"][:120]
+            flagship = fb
         # round-1/2 continuity row: the 1.70B dim-1280 stand-in
-        flagship_1p7b = try_flagship(1280, 10, 128, fbatch=4)
+        flagship_1p7b = run_flagship(1280, 10, "flash", fbatch=4, param_dtype="bfloat16")
 
     print(json.dumps({
         "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280)" if on_tpu

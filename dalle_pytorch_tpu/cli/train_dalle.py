@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--remat_policy", type=str, default="full",
                         choices=["full", "flash", "flash_qkv", "flash_qkv_ff"],
                         help="selective remat save policy for --execution remat")
+    parser.add_argument("--param_dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="param STORAGE dtype. bfloat16 = no f32 master copy "
+                             "(halves resident param memory; T5-style), optimizer "
+                             "math in f32, stochastic-rounded weight updates")
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-separated cycle of full,axial_row,axial_col,conv_like,sparse")
@@ -356,6 +361,9 @@ def main(argv=None):
         compute_dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
         clip_grad_norm=args.clip_grad_norm,
         zero_stage=args.zero_stage,
+        # explicit float32 (not None) so resuming a bf16 checkpoint into an
+        # f32 run re-materializes f32 masters rather than keeping bf16
+        param_dtype=jnp.bfloat16 if args.param_dtype == "bfloat16" else jnp.float32,
     )
     mesh_cfg = MeshConfig(args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp)
     state, step_fn, _, _ = be.distribute(

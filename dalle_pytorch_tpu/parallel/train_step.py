@@ -53,6 +53,48 @@ class StepSettings:
     # scale-invariant optimizers like adafactor.  Accumulation across
     # microbatches always runs in f32.
     grad_dtype: Any = jnp.float32
+    # Storage dtype for the params themselves.  None keeps whatever dtype the
+    # caller initialized (f32 masters — the safe default).  jnp.bfloat16 is
+    # the T5/mesh-tf recipe: NO f32 master copy exists (halves resident param
+    # memory — the other single-chip wall at >1B params); optimizer math still
+    # runs in f32 (casts fuse into the update), and the weight update applies
+    # with STOCHASTIC rounding so sub-ulp updates (lr·rms ~1e-3 relative,
+    # below bf16's 2^-8 ulp) accumulate in expectation instead of rounding
+    # away.  Pair with adafactor (its f32 factored stats are O(rows+cols)).
+    param_dtype: Any = None
+    # None → stochastic rounding on iff param_dtype is low-precision.
+    stochastic_round: Optional[bool] = None
+
+
+def _stochastic_round(x32: jnp.ndarray, key: jax.Array, dtype) -> jnp.ndarray:
+    """Round f32 -> bf16 stochastically: add uniform random bits below the
+    bf16 mantissa, then truncate.  P(round up) equals the fractional distance
+    to the next representable value, so E[rounded] = x and tiny optimizer
+    updates survive in expectation.  (Finite inputs only: +-inf would carry
+    into the NaN space — params/updates are finite in any sane run.)"""
+    assert dtype == jnp.bfloat16, "stochastic rounding implemented for bf16"
+    bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    rnd = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    bits = (bits + rnd) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(jnp.bfloat16)
+
+
+def _apply_updates_lowp(params, updates, key, dtype, stochastic: bool):
+    """params (low-precision) + updates (f32) -> new low-precision params."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    uleaves = treedef.flatten_up_to(updates)
+    keys = jax.random.split(key, len(leaves))
+    new = []
+    for p, u, k in zip(leaves, uleaves, keys):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            new.append(p)
+            continue
+        x32 = p.astype(jnp.float32) + u.astype(jnp.float32)
+        if stochastic:
+            new.append(_stochastic_round(x32, k, dtype))
+        else:
+            new.append(x32.astype(dtype))
+    return jax.tree_util.tree_unflatten(treedef, new)
 
 
 def make_train_step(
@@ -68,8 +110,28 @@ def make_train_step(
     step_fn(state, batch, key) -> (state, metrics); batch leaves have leading
     dim grad_accum * microbatch and are sharded over the data axes."""
 
+    lowp = settings.param_dtype is not None and jnp.dtype(settings.param_dtype).itemsize < 4
+    sr = settings.stochastic_round if settings.stochastic_round is not None else lowp
+    if lowp and jnp.dtype(settings.param_dtype) != jnp.dtype(jnp.bfloat16):
+        raise ValueError(
+            f"param_dtype {settings.param_dtype} not supported: low-precision "
+            "param storage is implemented for bfloat16 (stochastic rounding)"
+        )
+    if settings.stochastic_round and not lowp:
+        raise ValueError(
+            "stochastic_round=True requires a low-precision param_dtype "
+            f"(got param_dtype={settings.param_dtype})"
+        )
+
     def init_fn(params):
-        opt_state = optimizer.init(params)
+        if settings.param_dtype is not None:
+            # storage in param_dtype; optimizer state derives from the f32
+            # view when storage is low-precision, so factored stats and any
+            # full-shape moments stay f32 even though storage is bf16
+            params = cast_floating(params, settings.param_dtype)
+            opt_state = optimizer.init(cast_floating(params, jnp.float32) if lowp else params)
+        else:
+            opt_state = optimizer.init(params)
         state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
         if mesh is None:
             return state
@@ -119,6 +181,9 @@ def make_train_step(
     optimizer = optax.with_extra_args_support(optimizer)
 
     def step_fn_inner(state: TrainState, batch, key):
+        if lowp:
+            # reserve a rounding key BEFORE the loss consumes the stream
+            key, round_key = jax.random.split(key)
         grads, loss = grads_and_loss(state.params, batch, key)
         # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
         # no f32 copy of the gradient buffer is materialized)
@@ -132,10 +197,22 @@ def make_train_step(
                 lambda g: g * factor.astype(g.dtype), grads
             )
             gnorm = gnorm * factor  # the metric reports the applied norm
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params, value=loss
-        )
-        params = optax.apply_updates(state.params, updates)
+        if lowp:
+            # optimizer math in f32 (the casts fuse into the update kernels —
+            # no resident f32 copy); storage stays low-precision via
+            # stochastic rounding
+            updates, opt_state = optimizer.update(
+                cast_floating(grads, jnp.float32), state.opt_state,
+                cast_floating(state.params, jnp.float32), value=loss,
+            )
+            params = _apply_updates_lowp(
+                state.params, updates, round_key, settings.param_dtype, sr
+            )
+        else:
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params, value=loss
+            )
+            params = optax.apply_updates(state.params, updates)
         new_state = TrainState(state.step + 1, params, opt_state)
         metrics = {"loss": loss, "grad_norm": gnorm}
         return new_state, metrics

@@ -25,6 +25,15 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
+# npz can only hold numpy-native dtypes; accelerator dtypes (bfloat16 — e.g.
+# param_dtype=bfloat16 checkpoints — and the fp8 family) round-trip as uint8
+# bit-views plus a per-tree dtype sidecar.
+def _lowp_dtype(name: str):
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
 def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> None:
     """trees: named pytrees of arrays; meta: JSON-serializable metadata."""
     payload = {"__meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
@@ -33,8 +42,20 @@ def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> N
             continue
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         payload[f"__treedef_{name}"] = np.frombuffer(pickle.dumps(treedef), dtype=np.uint8)
+        dtypes = []
         for i, leaf in enumerate(leaves):
-            payload[f"{name}:{i}"] = np.asarray(leaf)
+            arr = np.asarray(leaf)
+            dtypes.append(arr.dtype.name)
+            try:
+                np.dtype(arr.dtype.name)  # numpy-native?
+            except TypeError:
+                # same-itemsize uint view: shape-preserving (works for 0-d)
+                u = np.dtype(f"u{arr.dtype.itemsize}")
+                arr = np.ascontiguousarray(arr).view(u)
+            payload[f"{name}:{i}"] = arr
+        payload[f"__dtypes_{name}"] = np.frombuffer(
+            json.dumps(dtypes).encode(), dtype=np.uint8
+        )
     path = str(path)
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -54,7 +75,17 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         for name in names:
             treedef = pickle.loads(bytes(data[f"__treedef_{name}"]))
             n = treedef.num_leaves
-            leaves = [data[f"{name}:{i}"] for i in range(n)]
+            dkey = f"__dtypes_{name}"
+            dtypes = (
+                json.loads(bytes(data[dkey]).decode()) if dkey in data.files else [None] * n
+            )
+            leaves = []
+            for i in range(n):
+                leaf = data[f"{name}:{i}"]
+                want = dtypes[i]
+                if want is not None and leaf.dtype.name != want:
+                    leaf = leaf.view(_lowp_dtype(want))  # uint8 bit-view back
+                leaves.append(leaf)
             trees[name] = jax.tree_util.tree_unflatten(treedef, leaves)
     return trees, meta
 

@@ -28,6 +28,33 @@ def test_roundtrip_trees_and_meta(tmp_path):
     np.testing.assert_array_equal(np.asarray(loaded["opt_state"][1]["mu"]), np.full((2, 2), 2.0))
 
 
+def test_roundtrip_bf16_leaves(tmp_path):
+    """npz has no bfloat16: bf16 leaves (param_dtype=bfloat16 checkpoints)
+    round-trip bit-exactly via the uint bit-view + dtype sidecar."""
+    trees = {
+        "weights": {
+            "w": jnp.asarray([[1.5, -2.25], [3.0, 0.007812]], jnp.bfloat16),
+            "scalar": jnp.asarray(2.5, jnp.bfloat16),  # 0-d must survive too
+            "f32": jnp.ones((3,), jnp.float32),
+            "step": jnp.asarray(7, jnp.int32),
+        }
+    }
+    path = tmp_path / "bf16.pt"
+    save_checkpoint(str(path), trees, {"epoch": 0})
+    loaded, _ = load_checkpoint(str(path))
+    w = loaded["weights"]
+    assert w["w"].dtype == jnp.bfloat16 and w["w"].shape == (2, 2)
+    assert w["scalar"].dtype == jnp.bfloat16 and w["scalar"].shape == ()
+    assert w["f32"].dtype == np.float32 and w["step"].dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(w["w"], np.float32), np.asarray(trees["weights"]["w"], np.float32)
+    )
+    assert float(np.asarray(w["scalar"], np.float32)) == 2.5
+    # jax must accept the restored leaves directly (the original failure mode:
+    # void-dtype arrays out of npz broke jit argument interpretation)
+    jnp.asarray(w["w"]) + 1
+
+
 def test_atomic_overwrite(tmp_path):
     path = tmp_path / "c.pt"
     save_checkpoint(str(path), {"w": {"x": jnp.zeros(2)}}, {"v": 1})

@@ -181,6 +181,56 @@ def test_bf16_compute_policy():
     assert state.params["logits_linear"]["w"].dtype == jnp.float32
 
 
+def test_stochastic_round_is_unbiased_and_exact():
+    from dalle_pytorch_tpu.parallel.train_step import _stochastic_round
+
+    # exactly-representable values pass through unchanged under every key
+    x = jnp.asarray([1.0, -2.5, 0.0, 3.140625], jnp.float32)  # all bf16-exact
+    for seed in range(3):
+        got = _stochastic_round(x, jax.random.PRNGKey(seed), jnp.bfloat16)
+        np.testing.assert_array_equal(np.asarray(got, np.float32), np.asarray(x))
+
+    # a value 1/4 of the way between two bf16 neighbours rounds up ~25% of
+    # the time, and the MEAN equals the true value (unbiased) — whereas
+    # nearest-rounding would pin it to the lower neighbour every time
+    lo = np.float32(1.0)
+    hi = np.float32(np.nextafter(jnp.bfloat16(1.0), jnp.bfloat16(2.0)))
+    x = jnp.full((4096,), lo + 0.25 * (hi - lo), jnp.float32)
+    got = np.asarray(_stochastic_round(x, jax.random.PRNGKey(7), jnp.bfloat16), np.float32)
+    frac_up = (got == hi).mean()
+    assert abs(frac_up - 0.25) < 0.03, frac_up
+    assert set(np.unique(got)) <= {lo, hi}
+
+
+def test_pure_bf16_params_with_stochastic_rounding():
+    """param_dtype=bf16: storage is bf16 with NO f32 master, optimizer stats
+    stay f32, and tiny-lr training still makes progress (sub-ulp updates
+    survive stochastic rounding; deterministic rounding would freeze)."""
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    batch = batch_for(cfg)
+    init_fn, step_fn = make_train_step(
+        dalle_loss(cfg), optax.adafactor(3e-3),
+        settings=StepSettings(compute_dtype=jnp.bfloat16, grad_dtype=jnp.bfloat16,
+                              param_dtype=jnp.bfloat16),
+    )
+    state = init_fn(params)
+    assert state.params["logits_linear"]["w"].dtype == jnp.bfloat16
+    # adafactor's factored/full second moments derive from the f32 view
+    stat_dtypes = {x.dtype for x in jax.tree_util.tree_leaves(state.opt_state)
+                   if jnp.issubdtype(x.dtype, jnp.floating)}
+    assert stat_dtypes == {jnp.dtype(jnp.float32)}
+
+    first = None
+    for i in range(30):
+        state, m = step_fn(state, batch, jax.random.PRNGKey(i))
+        if first is None:
+            first = float(m["loss"])
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["loss"]) < first  # training moves despite bf16 storage
+    assert state.params["logits_linear"]["w"].dtype == jnp.bfloat16
+
+
 def test_grad_clipping():
     cfg = tiny_cfg()
     params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)

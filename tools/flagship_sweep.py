@@ -30,6 +30,8 @@ def main():
                     choices=["full", "flash", "flash_qkv", "flash_qkv_ff"])
     ap.add_argument("--execution", default="remat", choices=["remat", "sequential"])
     ap.add_argument("--grad_dtype", default="float32", choices=["float32", "bfloat16"])
+    ap.add_argument("--param_dtype", default="float32", choices=["float32", "bfloat16"],
+                    help="bfloat16 = no f32 master, stochastic-rounded updates")
     ap.add_argument("--opt", default="adafactor", choices=["adafactor", "adam"])
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--warmup", type=int, default=2)
@@ -42,38 +44,39 @@ def main():
         chip_peak_flops, dalle_step_flops, matmul_param_count,
     )
 
-    cfg = DALLEConfig(
-        dim=args.dim, depth=args.depth, heads=args.heads, dim_head=args.dim_head,
-        num_text_tokens=10000, text_seq_len=256,
-        num_image_tokens=8192, image_fmap_size=32,
-        attn_types=("full", "axial_row", "axial_col", "conv_like"),
-        shift_tokens=True, rotary_emb=True,
-        execution=args.execution, scan_layers=True, remat_policy=args.policy,
-        share_input_output_emb=True,
-    )
-    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    try:  # init OOMs for billion-param configs must yield a JSON row too
+        cfg = DALLEConfig(
+            dim=args.dim, depth=args.depth, heads=args.heads, dim_head=args.dim_head,
+            num_text_tokens=10000, text_seq_len=256,
+            num_image_tokens=8192, image_fmap_size=32,
+            attn_types=("full", "axial_row", "axial_col", "conv_like"),
+            shift_tokens=True, rotary_emb=True,
+            execution=args.execution, scan_layers=True, remat_policy=args.policy,
+            share_input_output_emb=True,
+        )
+        params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
 
-    def loss_fn(p, b, key):
-        return dalle_mod.forward(p, cfg, b["text"], b["image_codes"], return_loss=True)
+        def loss_fn(p, b, key):
+            return dalle_mod.forward(p, cfg, b["text"], b["image_codes"], return_loss=True)
 
-    opt = optax.adafactor(1e-3) if args.opt == "adafactor" else optax.adam(1e-4)
-    settings = StepSettings(
-        compute_dtype=jnp.bfloat16,
-        grad_dtype=jnp.bfloat16 if args.grad_dtype == "bfloat16" else jnp.float32,
-        grad_accum=args.ga,
-    )
-    init_fn, step_fn = make_train_step(loss_fn, opt, settings=settings)
-    state = init_fn(params)
-    del params
+        opt = optax.adafactor(1e-3) if args.opt == "adafactor" else optax.adam(1e-4)
+        settings = StepSettings(
+            compute_dtype=jnp.bfloat16,
+            grad_dtype=jnp.bfloat16 if args.grad_dtype == "bfloat16" else jnp.float32,
+            grad_accum=args.ga,
+            param_dtype=jnp.bfloat16 if args.param_dtype == "bfloat16" else None,
+        )
+        init_fn, step_fn = make_train_step(loss_fn, opt, settings=settings)
+        state = init_fn(params)
+        del params
 
-    batch = args.batch * args.ga
-    bd = {
-        "text": jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.text_seq_len), 0, cfg.num_text_tokens),
-        "image_codes": jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.image_seq_len), 0, cfg.num_image_tokens),
-    }
+        batch = args.batch * args.ga
+        bd = {
+            "text": jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.text_seq_len), 0, cfg.num_text_tokens),
+            "image_codes": jax.random.randint(jax.random.PRNGKey(2), (batch, cfg.image_seq_len), 0, cfg.num_image_tokens),
+        }
 
-    n_matmul = matmul_param_count(state.params)
-    try:
+        n_matmul = matmul_param_count(state.params)
         for i in range(max(args.warmup, 1)):  # >=1: the timed loop must not include compile
             state, m = step_fn(state, bd, jax.random.PRNGKey(i))
         float(m["loss"])

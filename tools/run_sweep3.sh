@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 sweep #3: pure-bf16 params (no f32 master, stochastic rounding).
+# Theory from sweeps #1/#2: the flash_qkv/_ff compile crashes and the
+# full-policy b8 compile hang are HBM-pressure pathologies (configs sat at
+# 14-20GB against the 16GB chip and the memory-assignment pass thrashed).
+# param_dtype=bfloat16 frees the 5.2GB master copy; if the theory holds,
+# every policy compiles fast and we finally see their real throughput.
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/sweep_results.jsonl
+run() {
+  echo "--- $*" >&2
+  PYTHONPATH=$PWD:/root/.axon_site timeout 900 python tools/flagship_sweep.py \
+    --grad_dtype bfloat16 --param_dtype bfloat16 "$@" 2>/dev/null | tail -1 | tee -a "$OUT"
+}
+
+# canary: small graph, validates the stochastic-rounding step on the chip
+run --dim 512 --depth 8 --heads 8 --dim_head 64 --batch 8 --policy flash_qkv
+
+# true-1.3B geometry, most-likely winners first
+run --dim 1152 --heads 8 --policy flash_qkv --batch 8
+run --dim 1152 --heads 8 --policy flash_qkv_ff --batch 4
+run --dim 1152 --heads 8 --policy flash --batch 8
+run --dim 1152 --heads 8 --policy full --batch 8
+run --dim 1152 --heads 8 --policy flash --batch 16
+run --dim 1152 --heads 8 --policy full --batch 16
+
+# 1.70B continuity geometry
+run --policy flash_qkv --batch 8
+echo "sweep3 done" >&2
