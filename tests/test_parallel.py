@@ -1,5 +1,6 @@
 """Multi-device tests on the 8-device virtual CPU mesh (conftest.py)."""
 import argparse
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -229,6 +230,38 @@ def test_pure_bf16_params_with_stochastic_rounding():
     assert np.isfinite(float(m["loss"]))
     assert float(m["loss"]) < first  # training moves despite bf16 storage
     assert state.params["logits_linear"]["w"].dtype == jnp.bfloat16
+
+
+def test_pure_bf16_on_mesh_matches_single_device():
+    """param_dtype=bf16 + stochastic rounding must be replica-consistent on a
+    mesh: same key -> same rounding decisions on every shard, so the sharded
+    loss trajectory tracks the single-device one."""
+    cfg = tiny_cfg()
+    batch = batch_for(cfg)
+    opt = optax.adafactor(1e-3)
+    settings = StepSettings(param_dtype=jnp.bfloat16, grad_dtype=jnp.bfloat16)
+    loss_fn = dalle_loss(cfg)
+
+    init_s, step_s = make_train_step(loss_fn, opt, settings=settings)
+    state_s = init_s(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    losses_s = []
+    for i in range(3):
+        state_s, m = step_s(state_s, batch, jax.random.PRNGKey(i))
+        losses_s.append(float(m["loss"]))
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    init_m, step_m = make_train_step(
+        loss_fn, opt, mesh=mesh, settings=dataclasses.replace(settings, zero_stage=3)
+    )
+    state_m = init_m(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    assert state_m.params["logits_linear"]["w"].dtype == jnp.bfloat16
+    losses_m = []
+    for i in range(3):
+        state_m, m = step_m(state_m, batch, jax.random.PRNGKey(i))
+        losses_m.append(float(m["loss"]))
+
+    # bf16 storage widens tolerance vs the f32 equivalence test
+    np.testing.assert_allclose(losses_s, losses_m, rtol=3e-2)
 
 
 def test_grad_clipping():
