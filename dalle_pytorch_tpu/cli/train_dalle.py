@@ -120,6 +120,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--mesh_fsdp", type=int, default=1)
     parser.add_argument("--mesh_tp", type=int, default=1)
     parser.add_argument("--mesh_sp", type=int, default=1)
+    parser.add_argument("--mesh_pp", type=int, default=1,
+                        help="pipeline stages (GPipe over the stacked-layer axis; "
+                             "requires --scan_layers and depth %% pp == 0)")
+    parser.add_argument("--pp_num_micro", type=int, default=None,
+                        help="pipeline microbatches (default: auto)")
     parser.add_argument("--flops_profiler", action="store_true",
                         help="capture a jax profiler trace around step 200 and stop at 201")
     return backend_mod.wrap_arg_parser(parser)
@@ -285,6 +290,16 @@ def main(argv=None):
         )
         start_params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), dalle_cfg)
 
+    # pipeline engagement follows THIS run's mesh, not the checkpoint's: a
+    # resume with --mesh_pp must activate the pipeline (and vice versa)
+    import dataclasses as _dc
+
+    dalle_cfg = _dc.replace(
+        dalle_cfg,
+        pipeline_axis="pp" if args.mesh_pp > 1 else None,
+        pp_num_micro=args.pp_num_micro,
+    )
+
     from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch
 
     warn_vocab_mismatch(dalle_cfg.num_text_tokens, tokenizer, is_root)
@@ -365,7 +380,9 @@ def main(argv=None):
         # f32 run re-materializes f32 masters rather than keeping bf16
         param_dtype=jnp.bfloat16 if args.param_dtype == "bfloat16" else jnp.float32,
     )
-    mesh_cfg = MeshConfig(args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp)
+    mesh_cfg = MeshConfig(
+        args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp, args.mesh_pp
+    )
     state, step_fn, _, _ = be.distribute(
         loss_fn=loss_fn, params=start_params, optimizer=optimizer,
         mesh_config=mesh_cfg, settings=settings,

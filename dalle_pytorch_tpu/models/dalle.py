@@ -60,6 +60,8 @@ class DALLEConfig:
     sparse_block_size: int = 16
     attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
     seq_shard_axis: Optional[str] = None  # sequence-parallel mesh axis (e.g. 'sp')
+    pipeline_axis: Optional[str] = None  # pipeline-parallel mesh axis (e.g. 'pp')
+    pp_num_micro: Optional[int] = None  # GPipe microbatches (None = auto)
 
     # -- derived ----------------------------------------------------------
     @property
@@ -110,6 +112,8 @@ class DALLEConfig:
             sparse_block_size=self.sparse_block_size,
             attn_kernel=self.attn_kernel,
             seq_shard_axis=self.seq_shard_axis,
+            pipeline_axis=self.pipeline_axis,
+            pp_num_micro=self.pp_num_micro,
         )
 
     def to_dict(self) -> dict:

@@ -87,6 +87,14 @@ class TransformerConfig:
     # (parallel/ring.py, O(n/P) memory fwd AND bwd) for 'full' layers —
     # the hand-tuned path for very long sequences
     seq_shard_axis: Optional[str] = None
+    # pipeline parallelism: shard the stacked-layer (depth) axis over this
+    # mesh axis and run the GPipe schedule (parallel/pipeline.py).  Requires
+    # scan_layers; composes with dp/fsdp/tp (they stay GSPMD-automatic inside
+    # each stage).  Falls back to plain scan with a warning when no mesh with
+    # the axis is installed.
+    pipeline_axis: Optional[str] = None
+    # microbatches per pipeline step (None = largest of 2P / P dividing batch)
+    pp_num_micro: Optional[int] = None
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
@@ -499,6 +507,11 @@ def apply_transformer(
     dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """x: (batch, n, dim) with n <= seq_len.  Full-sequence (training) mode."""
+    if cfg.pipeline_axis is not None and not cfg.scan_layers:
+        raise ValueError(
+            "pipeline_axis requires scan_layers=True (pipeline stages shard "
+            "the stacked layer params)"
+        )
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
     patterns = spec_patterns(cfg, specs)
@@ -656,6 +669,43 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
         body = _remat_wrap(body, cfg)
 
     xs = (stacked, midx, layer_keys) if layer_keys is not None else (stacked, midx)
+
+    if cfg.pipeline_axis is not None:
+        mesh = _ambient_mesh()
+        if (
+            mesh is not None
+            and cfg.pipeline_axis in mesh.shape
+            and mesh.shape[cfg.pipeline_axis] > 1
+        ):
+            from dalle_pytorch_tpu.parallel.pipeline import pipeline_scan
+
+            fold = None
+            if layer_keys is not None:
+                # each microbatch must draw its OWN dropout masks — fold the
+                # microbatch id into the per-layer keys (a single-stage scan
+                # draws one batch-wide mask; reusing it per microbatch would
+                # correlate dropout across the batch)
+                def fold(xs_local, micro_id):
+                    bundle, mi, keys2 = xs_local
+                    flat = keys2.reshape(-1, keys2.shape[-1])
+                    folded = jax.vmap(
+                        lambda k: jax.random.fold_in(k, micro_id)
+                    )(flat).reshape(keys2.shape)
+                    return (bundle, mi, folded)
+
+            return pipeline_scan(
+                body, seq_constraint(x), xs, mesh,
+                axis=cfg.pipeline_axis, num_micro=cfg.pp_num_micro,
+                fold_micro=fold,
+            )
+        import warnings
+
+        warnings.warn(
+            f"pipeline_axis={cfg.pipeline_axis!r} but no mesh with that axis "
+            ">1 is installed — falling back to single-stage lax.scan",
+            stacklevel=2,
+        )
+
     out, _ = jax.lax.scan(body, seq_constraint(x), xs)
     return out
 

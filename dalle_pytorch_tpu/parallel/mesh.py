@@ -8,9 +8,11 @@ single logical `jax.sharding.Mesh` over all devices with four named axes:
   fsdp  data parallelism + parameter/optimizer sharding (ZeRO-3 style)
   tp    tensor parallelism (attention heads / ff hidden sharded)
   sp    sequence/context parallelism (ring attention, parallel/ring.py)
+  pp    pipeline parallelism (GPipe stage schedule, parallel/pipeline.py)
 
 Collectives are never called explicitly for training — XLA emits them from
-sharding annotations, riding ICI within a slice and DCN across slices.
+sharding annotations, riding ICI within a slice and DCN across slices (the
+one exception: the pipeline's stage-hop ppermute, which is manual by nature).
 """
 from __future__ import annotations
 
@@ -25,7 +27,8 @@ AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
-MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP)
+AXIS_PP = "pp"
+MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP)
 
 # batch is sharded over every data-like axis
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
@@ -37,23 +40,24 @@ class MeshConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.fsdp * self.tp * self.sp
+        fixed = self.fsdp * self.tp * self.sp * self.pp
         dp = self.dp
         if dp == -1:
             assert n_devices % fixed == 0, (n_devices, fixed)
             dp = n_devices // fixed
         assert dp * fixed == n_devices, (
-            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
+            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.pp} != {n_devices} devices"
         )
-        return MeshConfig(dp, self.fsdp, self.tp, self.sp)
+        return MeshConfig(dp, self.fsdp, self.tp, self.sp, self.pp)
 
 
 def make_mesh(cfg: MeshConfig = MeshConfig(), devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     cfg = cfg.resolve(len(devices))
-    arr = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp)
+    arr = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.pp)
     return Mesh(arr, MESH_AXES)
 
 

@@ -1,0 +1,119 @@
+"""Pipeline parallelism: a GPipe schedule over a `pp` mesh axis.
+
+The reference has no pipeline engine (DeepSpeed's existed but DALLE-pytorch
+never wired it up); for the depth-64 flagship geometry pipeline stages are the
+natural TPU scale-out axis once tensor parallelism saturates a slice.  Design:
+
+- The transformer's scan-layers execution already stacks per-layer params
+  along a leading depth axis; pipelining shards THAT axis over `pp` — each
+  stage holds depth/P contiguous layers and runs them with the same
+  (rematted) per-layer body the single-chip path uses.
+- Schedule: GPipe with M microbatches over P stages, T = M+P-1 ticks inside
+  one `lax.scan`; activations hop stages with a single `ppermute` per tick.
+  Bubble fraction (P-1)/T.
+- Composition: `jax.shard_map(..., axis_names={'pp'})` is manual ONLY over
+  `pp`; dp/fsdp/tp/sp stay automatic, so GSPMD still emits gradient
+  all-reduces, ZeRO-3 gathers, and Megatron TP collectives inside each stage.
+- Backward: plain AD through the tick scan — `ppermute` transposes to the
+  reverse rotation, which IS the backward pipeline schedule; weight gradients
+  accumulate across microbatch ticks automatically.
+
+Known costs (documented, not hidden): inputs/outputs are materialized on all
+stages (O(M·mb) activations replicated over `pp`), and everything outside the
+layer stack (embeddings, head, loss) computes redundantly on every stage —
+head+embeddings are a few percent of depth-64 FLOPs.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from dalle_pytorch_tpu.parallel.mesh import AXIS_PP
+
+P = PartitionSpec
+
+
+def default_num_micro(batch: int, stages: int) -> int:
+    """The divisor of `batch` that is >= stages (keeps every stage busy) and
+    closest to 2*stages (the bubble/activation-memory sweet spot); if no
+    divisor reaches `stages`, the largest divisor — never a silent M=1 when
+    a better split exists."""
+    divs = [m for m in range(1, batch + 1) if batch % m == 0]
+    cands = [m for m in divs if m >= stages]
+    if cands:
+        return min(cands, key=lambda m: (abs(m - 2 * stages), m))
+    return max(divs)
+
+
+def pipeline_scan(
+    body: Callable,  # (h, xs_i) -> (h, ignored) — one layer, as lax.scan body
+    x: jnp.ndarray,  # (batch, ...) activations
+    xs: Any,  # pytree, leaves stacked over a leading depth axis
+    mesh: Mesh,
+    axis: str = AXIS_PP,
+    num_micro: Optional[int] = None,
+    fold_micro: Optional[Callable] = None,  # (xs_local, micro_id) -> xs_local
+) -> jnp.ndarray:
+    """Drop-in replacement for `lax.scan(body, x, xs)[0]` over stacked layers,
+    with the depth axis sharded over `axis` and the batch microbatched.
+
+    `fold_micro` lets the caller derive per-microbatch values from the
+    per-layer xs before the stage applies them — e.g. folding the microbatch
+    index into dropout keys so microbatches don't share masks (a single-stage
+    scan draws one mask for the whole batch; a pipeline processes microbatches
+    separately and must not reuse the identical mask for each)."""
+    stages = mesh.shape[axis]
+    depth = jax.tree_util.tree_leaves(xs)[0].shape[0]
+    batch = x.shape[0]
+    assert depth % stages == 0, f"depth {depth} % pp {stages} != 0"
+    if num_micro is None:
+        num_micro = default_num_micro(batch, stages)
+    assert batch % num_micro == 0, f"batch {batch} % num_micro {num_micro} != 0"
+    xm = x.reshape(num_micro, batch // num_micro, *x.shape[1:])
+
+    def per_stage(xs_local, xm_in):
+        s = jax.lax.axis_index(axis)
+        ticks = num_micro + stages - 1
+
+        def stage(h, micro_id):
+            ws = xs_local if fold_micro is None else fold_micro(xs_local, micro_id)
+            h, _ = jax.lax.scan(lambda h, w: (body(h, w)[0], None), h, ws)
+            return h
+
+        def tick(carry, t):
+            h, outs = carry
+            x_in = jax.lax.dynamic_index_in_dim(
+                xm_in, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
+            )
+            h = jnp.where(s == 0, x_in, h)  # first stage ingests microbatch t
+            # the microbatch this stage holds at tick t (clipped in the bubble)
+            h = stage(h, jnp.clip(t - s, 0, num_micro - 1))
+            oidx = t - (stages - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, h, jnp.clip(oidx, 0, num_micro - 1), 0
+            )
+            outs = jnp.where((s == stages - 1) & (oidx >= 0), upd, outs)
+            h = jax.lax.ppermute(
+                h, axis, [(i, (i + 1) % stages) for i in range(stages)]
+            )
+            return (h, outs), None
+
+        # initial carries are pp-varying (each stage evolves its own)
+        h0 = jax.lax.pcast(jnp.zeros_like(xm_in[0]), (axis,), to="varying")
+        outs0 = jax.lax.pcast(jnp.zeros_like(xm_in), (axis,), to="varying")
+        (_, outs), _ = jax.lax.scan(tick, (h0, outs0), jnp.arange(ticks))
+        return outs[None]  # leading singleton stacks over `axis` outside
+
+    xs_specs = jax.tree_util.tree_map(lambda _: P(axis), xs)
+    fn = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(xs_specs, P()),
+        out_specs=P(axis),
+        axis_names={axis},
+    )
+    outs = fn(xs, xm)  # (stages, num_micro, micro_b, ...)
+    return outs[-1].reshape(batch, *x.shape[1:])

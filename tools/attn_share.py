@@ -29,9 +29,7 @@ def main():
     ap.add_argument("--steps", type=int, default=8)
     args = ap.parse_args()
 
-    from dalle_pytorch_tpu.kernels.flash_attention import (
-        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention, resolve_block,
-    )
+    from dalle_pytorch_tpu.kernels.flash_attention import flash_attention
     from dalle_pytorch_tpu.ops.masks import _pattern_mask_np
 
     b, h, n, d = args.batch, args.heads, args.seq, args.dim_head
@@ -42,8 +40,6 @@ def main():
     )
 
     def bench_one(name, mask_np):
-        mask = None if mask_np is None else jnp.asarray(mask_np)
-
         def fwd(q, k, v):
             return flash_attention(q, k, v, mask=mask_np, causal=True).sum()
 
