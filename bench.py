@@ -44,8 +44,114 @@ def _chip_peak() -> float:
 from dalle_pytorch_tpu.training.profiling import dalle_step_flops, matmul_param_count
 
 
+def _probe_backend(timeout_s: int = 240) -> str:
+    """Probe the ambient backend in a throwaway child process; returns
+    'tpu', 'cpu' (clean CPU-only environment), or 'dead' (init raised or
+    blocked).
+
+    TPU-tunnel failure modes seen in practice: backend init raises
+    UNAVAILABLE (BENCH_r03 rc=1) or blocks forever in a retry loop
+    (MULTICHIP_r03 rc=124).  Probing in a child with a hard timeout keeps
+    both failure modes out of the bench process itself."""
+    import os
+    import subprocess
+    import sys
+
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True, env=dict(os.environ),
+        )
+    except Exception:
+        return "dead"
+    if proc.returncode != 0:
+        return "dead"
+    if "BACKEND=tpu" in proc.stdout:
+        return "tpu"
+    if "BACKEND=" in proc.stdout:
+        return "cpu"
+    return "dead"
+
+
+def _reexec_cpu_degraded() -> None:
+    """Re-exec the bench with the TPU tunnel disowned so a degraded CPU run
+    still prints the JSON line instead of exiting nonzero.
+
+    PALLAS_AXON_POOL_IPS must be removed from the child's *environment*:
+    the axon PJRT plugin's sitecustomize hook dials the relay at
+    interpreter startup whenever it is set (same defense as
+    tests/conftest.py)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["_GRAFT_BENCH_DEGRADED"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+    sys.exit(proc.returncode)
+
+
+def _arm_init_watchdog(timeout_s: int = 300):
+    """Last-ditch escape for the probe-passed-then-tunnel-died window: if the
+    parent's own backend init blocks in the PJRT retry loop (the rc=124
+    mode — it never raises, so try/except can't catch it), a timer thread
+    execve()s this process into the degraded CPU bench so the JSON line
+    still gets printed.  Returns an Event to set once the backend is up."""
+    import os
+    import sys
+    import threading
+
+    ready = threading.Event()
+
+    def watch():
+        if ready.wait(timeout_s):
+            return
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONUNBUFFERED"] = "1"
+        env["_GRAFT_BENCH_DEGRADED"] = "1"
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # execve replaces the whole process, including the thread stuck in
+        # native backend-init code
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return ready
+
+
 def main():
-    on_tpu = jax.default_backend() == "tpu"
+    import os
+
+    degraded = bool(os.environ.get("_GRAFT_BENCH_DEGRADED"))
+    probe = "cpu" if degraded else _probe_backend()
+    if probe == "dead":
+        _reexec_cpu_degraded()  # never returns
+    watchdog_ready = None
+    if probe == "tpu":
+        watchdog_ready = _arm_init_watchdog()
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        # probe passed but init still failed (transient tunnel flake):
+        # degrade rather than die without the JSON line
+        if not degraded:
+            _reexec_cpu_degraded()
+        raise
+    if watchdog_ready is not None:
+        watchdog_ready.set()
+    # second watchdog over the whole TPU measurement section: a wedged
+    # remote compiler can hang any in-process TPU computation indefinitely;
+    # 40 min comfortably covers the worst legitimate run (compile + steps +
+    # two 14-min-capped flagship subprocess rows)
+    bench_done = _arm_init_watchdog(2400) if on_tpu else None
 
     from dalle_pytorch_tpu.models import dalle as dalle_mod
     from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -184,6 +290,7 @@ def main():
         ]
         env = dict(os.environ)
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = None
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=timeout_s,
@@ -196,10 +303,8 @@ def main():
         except Exception as e:
             # no JSON line (e.g. hard crash): surface the subprocess stderr
             tail = ""
-            try:
+            if proc is not None and proc.stderr and proc.stderr.strip():
                 tail = " :: " + proc.stderr.strip().splitlines()[-1][:150]
-            except Exception:
-                pass
             return {"error": (repr(e) + tail)[:300]}
         if "error" in row:
             return {"error": row["error"][:200]}
@@ -232,17 +337,19 @@ def main():
         # round-1/2 continuity row: the 1.70B dim-1280 stand-in
         flagship_1p7b = run_flagship(1280, 10, "flash", fbatch=4, param_dtype="bfloat16")
 
-    print(json.dumps({
-        "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280)" if on_tpu
-                  else "img-tokens/sec/chip (CPU smoke)",
-        "value": round(img_tok_per_sec, 1),
-        "unit": "img-tokens/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
+    # dim-2048/depth-8 single-chip row — kept as a secondary metric; the
+    # BASELINE.md:25 target is written for the 1.3B depth-64 geometry, which
+    # is the headline below whenever it was actually measured.
+    proxy_row = {
         "mfu": round(mfu, 4),
+        "img_tok_per_sec": round(img_tok_per_sec, 1),
         "step_time_s": round(step_time, 4),
         "params_million": params_million,
         "batch": batch,
         "loss": final_loss,
+    }
+    common = {
+        "proxy_dim2048_depth8": proxy_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None
@@ -250,7 +357,39 @@ def main():
         "flagship_1p3b_depth64": flagship,
         "flagship_1p7b_dim1280": flagship_1p7b,
         "backend": jax.default_backend(),
-    }))
+        "degraded": degraded,
+    }
+    if on_tpu and flagship is not None and "error" not in flagship:
+        out = {
+            "metric": "MFU (flagship 1.3B depth-64 DALL-E train step, seq=1280)",
+            "value": flagship["mfu"],
+            "unit": "MFU",
+            "vs_baseline": round(flagship["mfu"] / 0.45, 4),
+            **common,
+        }
+    elif on_tpu:
+        out = {
+            "metric": "img-tokens/sec/chip (DALL-E train step, seq=1280; "
+                      "flagship row errored, dim-2048 proxy headline)",
+            "value": round(img_tok_per_sec, 1),
+            "unit": "img-tokens/s/chip",
+            "vs_baseline": round(mfu / 0.45, 4),
+            **common,
+        }
+    else:
+        out = {
+            "metric": "img-tokens/sec/chip (CPU smoke — TPU tunnel unavailable)"
+                      if degraded else "img-tokens/sec/chip (CPU smoke)",
+            "value": round(img_tok_per_sec, 1),
+            "unit": "img-tokens/s/chip",
+            # no TPU measurement happened: report 0 against the TPU target
+            # rather than a fake ratio from CPU timings
+            "vs_baseline": 0.0,
+            **common,
+        }
+    if bench_done is not None:
+        bench_done.set()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

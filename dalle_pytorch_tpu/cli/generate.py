@@ -72,7 +72,24 @@ def main(argv=None):
         load_reference_dalle_checkpoint,
     )
 
-    if is_torch_checkpoint(str(path)):
+    from dalle_pytorch_tpu.training.checkpoint import is_sharded_checkpoint
+
+    if is_sharded_checkpoint(str(path)):
+        # orbax sharded training checkpoint (train_dalle --sharded_checkpoint):
+        # template-free restore materializes the saved structure locally
+        from dalle_pytorch_tpu.training.checkpoint import load_sharded
+
+        restored, meta = load_sharded(str(path))
+        vae_trees, vae_side_meta = load_checkpoint(str(path / "vae.npz"))
+        if meta.get("version") != __version__:
+            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
+        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
+        vae_cfg = vae_registry.config_from_meta(
+            vae_side_meta.get("vae_class_name", "DiscreteVAE"), vae_side_meta["vae_params"]
+        )
+        params = restored["weights"]
+        vae_params = vae_trees["vae_weights"]
+    elif is_torch_checkpoint(str(path)):
         # a dalle.pt trained with the torch reference — convert on load
         taming_config = None
         if args.vqgan_config_path:  # --taming is implied by the config path

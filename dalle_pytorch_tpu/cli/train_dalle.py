@@ -32,7 +32,15 @@ from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
 from dalle_pytorch_tpu.parallel.mesh import MeshConfig
 from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState
-from dalle_pytorch_tpu.training.checkpoint import load_checkpoint, rotate_checkpoints, save_checkpoint, to_host
+from dalle_pytorch_tpu.training.checkpoint import (
+    is_sharded_checkpoint,
+    load_checkpoint,
+    load_sharded,
+    rotate_checkpoints,
+    save_checkpoint,
+    save_sharded,
+    to_host,
+)
 from dalle_pytorch_tpu.training.logging import MetricLogger
 from dalle_pytorch_tpu.version import __version__
 
@@ -99,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--epochs", type=int, default=20)
     parser.add_argument("--save_every_n_steps", type=int, default=1000)
     parser.add_argument("--keep_n_checkpoints", type=int, default=None)
+    parser.add_argument(
+        "--sharded_checkpoint", action="store_true",
+        help="save checkpoints in the orbax sharded directory format: every "
+             "host writes only its own shards, so ZeRO-3-sharded params and "
+             "optimizer state are never gathered to one host (the npz path "
+             "gathers — multi-GB at billion-param scale and a non-starter "
+             "multi-host).  Checkpoint paths become directories; --dalle_path "
+             "accepts them for resume.")
     parser.add_argument("--batch_size", type=int, default=4)
     parser.add_argument("--ga_steps", type=int, default=1, help="gradient accumulation steps")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -205,7 +221,53 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
     )
     if keep_n is not None:
         d = str(Path(path).parent)
-        rotate_checkpoints(d, Path(path).stem + "_step*.npz", keep_n)
+        rotate_checkpoints(d, _rotation_glob(path), keep_n)
+
+
+def _rotation_glob(path) -> str:
+    """Glob matching this run's step checkpoints.  `path` is the step file
+    itself (`<name>_step<N>.npz`), so the run name must be recovered by
+    stripping the step suffix — globbing on the full stem matched nothing and
+    rotation silently never deleted anything."""
+    import re
+
+    p = Path(path)
+    stem = re.sub(r"_step\d+$", "", p.stem)
+    return stem + "_step*" + p.suffix
+
+
+def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+                       keep_n=None, global_step=0, wandb_run_id=None):
+    """Distributed save: the TrainState goes through orbax, each host writing
+    only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
+    never gathered (`save_model`'s np.asarray would pull the full arrays to
+    one host).  The small frozen VAE rides in a sidecar npz inside the
+    checkpoint directory.  Collective: call from ALL processes."""
+    class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
+    meta = {
+        "hparams": dalle_cfg.to_dict(),
+        "vae_params": vae_meta,
+        "epoch": epoch,
+        "global_step": int(global_step),
+        "wandb_run_id": wandb_run_id,
+        "version": __version__,
+        "vae_class_name": class_name,
+        "scheduler_state": None,
+    }
+    path = Path(path)
+    save_sharded(
+        str(path),
+        {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
+        meta,
+    )
+    if jax.process_index() == 0:
+        save_checkpoint(
+            str(path / "vae.npz"),
+            trees={"vae_weights": to_host(vae_params)},
+            meta={"vae_params": vae_meta, "vae_class_name": class_name},
+        )
+        if keep_n is not None:
+            rotate_checkpoints(str(path.parent), _rotation_glob(path), keep_n)
 
 
 def main(argv=None):
@@ -242,11 +304,25 @@ def main(argv=None):
             if is_root:
                 print(f"resuming from reference checkpoint {args.dalle_path} "
                       f"(epoch {ref_resume['epoch']}, fresh optimizer state)")
-    resume = (
-        load_checkpoint(args.dalle_path)
-        if args.dalle_path is not None and ref_resume is None
-        else None
-    )
+    sharded_resume = None
+    if (args.dalle_path is not None and ref_resume is None
+            and is_sharded_checkpoint(args.dalle_path)):
+        # orbax sharded checkpoint directory: read the cheap parts now (meta
+        # json + VAE sidecar); the sharded TrainState is restored onto THIS
+        # run's mesh after distribution — no host gather at any point
+        import json as _json
+
+        sharded_resume = args.dalle_path
+        vae_trees, vae_side_meta = load_checkpoint(str(Path(args.dalle_path) / "vae.npz"))
+        meta = _json.loads((Path(args.dalle_path) / "meta.json").read_text())
+        meta.update(vae_side_meta)
+        resume = ({"vae_weights": vae_trees["vae_weights"]}, meta)
+    else:
+        resume = (
+            load_checkpoint(args.dalle_path)
+            if args.dalle_path is not None and ref_resume is None
+            else None
+        )
 
     if ref_resume is not None:
         vae_params, vae_cfg = ref_resume["vae_params"], ref_resume["vae_config"]
@@ -262,7 +338,11 @@ def main(argv=None):
     elif resume is not None:
         trees, resume_meta = resume
         dalle_cfg = DALLEConfig.from_dict(resume_meta["hparams"])
-        start_params = trees["weights"]
+        if sharded_resume is not None:
+            # weights arrive sharded after be.distribute; init placeholders
+            start_params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), dalle_cfg)
+        else:
+            start_params = trees["weights"]
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
         dalle_cfg = DALLEConfig.from_vae(
@@ -387,7 +467,15 @@ def main(argv=None):
         loss_fn=loss_fn, params=start_params, optimizer=optimizer,
         mesh_config=mesh_cfg, settings=settings,
     )
-    if resume_meta is not None and "opt_state" in trees:
+    if sharded_resume is not None:
+        # restore shard-by-shard onto this run's state (its shardings define
+        # the placement — the save mesh may have had a different shape)
+        restored, _ = load_sharded(
+            sharded_resume,
+            {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
+        )
+        state = TrainState(restored["step"], restored["weights"], restored["opt_state"])
+    elif resume_meta is not None and "opt_state" in trees:
         state = TrainState(state.step, state.params, jax.tree_util.tree_map(
             lambda cur, saved: jnp.asarray(saved).astype(cur.dtype) if hasattr(cur, "dtype") else saved,
             state.opt_state, trees["opt_state"],
@@ -410,13 +498,18 @@ def main(argv=None):
     def save(path, epoch, keep_n=None, step=None):
         # `step` is the NEXT step to run after resume; mid-loop callers pass
         # global_step + 1 (the increment happens at loop end)
-        save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
-                   keep_n=keep_n,
-                   global_step=global_step if step is None else step,
-                   wandb_run_id=logger.run_id)
+        fn = save_model_sharded if args.sharded_checkpoint else save_model
+        fn(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+           keep_n=keep_n,
+           global_step=global_step if step is None else step,
+           wandb_run_id=logger.run_id)
+
+    # orbax saves are collective (every host writes its shards), so they run
+    # on all processes; the npz path writes from the root host only
+    save_here = is_root or args.sharded_checkpoint
 
     # save-before-train fail-fast (reference train_dalle.py:591-594)
-    if is_root:
+    if save_here:
         save(out_file, start_epoch)
 
     key = jax.random.PRNGKey(args.seed + 1)
@@ -452,7 +545,7 @@ def main(argv=None):
                 t_window = time.time()
                 window_start = global_step + 1
                 logger.log(record, step=global_step)
-            if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and is_root:
+            if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and save_here:
                 step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
                 save(step_file, epoch, keep_n=args.keep_n_checkpoints,
                      step=global_step + 1)
@@ -468,13 +561,15 @@ def main(argv=None):
                     return state, dalle_cfg
             global_step += 1
 
-        if is_root:
+        if save_here:
             save(out_file, epoch + 1)
-            logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
+            if is_root:
+                logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
 
-    if is_root:
+    if save_here:
         save(out_file, args.epochs)
-        logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
+        if is_root:
+            logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
     logger.finish()
     return state, dalle_cfg
 

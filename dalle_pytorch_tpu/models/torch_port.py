@@ -206,8 +206,11 @@ def convert_dalle_state_dict(state: Dict, cfg: DALLEConfig) -> dict:
 def is_torch_checkpoint(path: str) -> bool:
     """True for torch-format save files (zip with a data.pkl member or legacy
     pickle) — as opposed to this framework's npz checkpoints."""
+    import os
     import zipfile
 
+    if os.path.isdir(path):  # orbax sharded checkpoint directories
+        return False
     try:
         with zipfile.ZipFile(path) as z:
             names = z.namelist()

@@ -512,6 +512,13 @@ def apply_transformer(
             "pipeline_axis requires scan_layers=True (pipeline stages shard "
             "the stacked layer params)"
         )
+    if cfg.pipeline_axis is not None and cfg.execution == "reversible":
+        # the reversible runner returns before the scan path, so pp would be
+        # silently ignored and every stage would compute a full replica
+        raise ValueError(
+            "pipeline_axis is not supported with execution='reversible'; use "
+            "execution='remat' (or 'sequential') with scan_layers=True"
+        )
     specs = derive_layer_specs(cfg)
     rotary = transformer_rotary(cfg)
     patterns = spec_patterns(cfg, specs)

@@ -18,6 +18,14 @@ natural TPU scale-out axis once tensor parallelism saturates a slice.  Design:
   reverse rotation, which IS the backward pipeline schedule; weight gradients
   accumulate across microbatch ticks automatically.
 
+Bubble ticks are skipped with `lax.cond` (a stage holding no valid
+microbatch does no layer compute — without this, (P-1)/T of all stage
+compute ran on clipped garbage ids and was discarded), and the output
+collection writes one microbatch slice per tick instead of selecting over
+the whole buffer.  Param/optimizer memory scaling over pp comes from the
+sharding rules (parallel/sharding.py folds `pp` into the data-sharding
+axes), not from this schedule.
+
 Known costs (documented, not hidden): inputs/outputs are materialized on all
 stages (O(M·mb) activations replicated over `pp`), and everything outside the
 layer stack (embeddings, head, loss) computes redundantly on every stage —
@@ -89,13 +97,23 @@ def pipeline_scan(
                 xm_in, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
             )
             h = jnp.where(s == 0, x_in, h)  # first stage ingests microbatch t
-            # the microbatch this stage holds at tick t (clipped in the bubble)
-            h = stage(h, jnp.clip(t - s, 0, num_micro - 1))
-            oidx = t - (stages - 1)
-            upd = jax.lax.dynamic_update_index_in_dim(
-                outs, h, jnp.clip(oidx, 0, num_micro - 1), 0
+            # the microbatch this stage holds at tick t; outside [0, M) the
+            # stage is in the bubble and skips its layer compute entirely
+            micro_id = t - s
+            valid = (micro_id >= 0) & (micro_id < num_micro)
+            h = jax.lax.cond(
+                valid,
+                lambda h: stage(h, jnp.clip(micro_id, 0, num_micro - 1)),
+                lambda h: h,
+                h,
             )
-            outs = jnp.where((s == stages - 1) & (oidx >= 0), upd, outs)
+            # collect finished microbatches: one slice-sized select per tick
+            # (only the last stage's buffer is ever read back; other stages
+            # harmlessly overwrite their local copy)
+            oidx = jnp.clip(t - (stages - 1), 0, num_micro - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+            val = jnp.where(t - (stages - 1) >= 0, h, prev)
+            outs = jax.lax.dynamic_update_index_in_dim(outs, val, oidx, 0)
             h = jax.lax.ppermute(
                 h, axis, [(i, (i + 1) % stages) for i in range(stages)]
             )

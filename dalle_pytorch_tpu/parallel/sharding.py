@@ -12,15 +12,24 @@ ZeRO parity map (SURVEY.md §2.3):
 Tensor parallelism shards attention heads and ff hidden over `tp` — qkv /
 ff-in projections column-wise, out / ff-out projections row-wise, so XLA emits
 exactly one all-reduce per residual branch (the Megatron pattern, expressed
-through GSPMD annotations instead of hand-written collectives)."""
+through GSPMD annotations instead of hand-written collectives).
+
+Pipeline meshes (pp > 1) fold the `pp` axis into the data-sharding axes: at
+rest, params and optimizer moments shard over (fsdp, pp) combined, so adding
+pipeline stages scales memory the same way adding fsdp shards does.  Inside
+the step, GSPMD re-lays the stacked layer params out to the pipeline's
+per-stage P('pp') placement (the same traffic class as ZeRO-3's gathers);
+without this, every stage would hold the full stacked params and redundantly
+compute the whole optimizer update (advisor finding, round 3)."""
 from __future__ import annotations
 
-from typing import Any, Optional
+import math
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from dalle_pytorch_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP
+from dalle_pytorch_tpu.parallel.mesh import AXIS_FSDP, AXIS_PP, AXIS_TP
 
 P = PartitionSpec
 
@@ -39,30 +48,62 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def _shard_largest(leaf, axis_name: str, mesh: Mesh, min_size: int = 2 ** 14) -> PartitionSpec:
-    """Spec sharding the largest divisible dim of `leaf` over `axis_name`."""
-    if leaf.ndim == 0 or leaf.size < min_size:
+def _data_axes(mesh: Mesh, include_fsdp: bool) -> Tuple[str, ...]:
+    """Mesh axes params/moments shard over at rest: fsdp (when ZeRO says so)
+    plus pp whenever the mesh actually has pipeline stages."""
+    axes = []
+    if include_fsdp and mesh.shape.get(AXIS_FSDP, 1) > 1:
+        axes.append(AXIS_FSDP)
+    if mesh.shape.get(AXIS_PP, 1) > 1:
+        axes.append(AXIS_PP)
+    return tuple(axes)
+
+
+def _axes_prod(mesh: Mesh, axes: Sequence[str]) -> int:
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def _shard_largest(leaf, axes: Tuple[str, ...], mesh: Mesh, min_size: int = 2 ** 14) -> PartitionSpec:
+    """Spec sharding the largest divisible dim of `leaf` over `axes` (tried
+    as the full tuple first, then each axis alone, so an odd dim still gets
+    whatever sharding fits)."""
+    if not axes or leaf.ndim == 0 or leaf.size < min_size:
         return P()
-    axis_size = mesh.shape[axis_name]
+    candidates = [axes] if len(axes) == 1 else [axes, *[(a,) for a in axes]]
     dims = list(leaf.shape)
     order = sorted(range(len(dims)), key=lambda i: -dims[i])
-    for i in order:
-        if dims[i] % axis_size == 0 and dims[i] >= axis_size:
-            spec = [None] * len(dims)
-            spec[i] = axis_name
-            return P(*spec)
+    for cand in candidates:
+        size = _axes_prod(mesh, cand)
+        for i in order:
+            if dims[i] % size == 0 and dims[i] >= size:
+                spec = [None] * len(dims)
+                spec[i] = cand if len(cand) > 1 else cand[0]
+                return P(*spec)
     return P()
 
 
-def _tp_spec(path: str, leaf, fsdp: Optional[str]) -> Optional[PartitionSpec]:
+def _data_slot(dim_size: int, axes: Tuple[str, ...], mesh: Mesh):
+    """The data-axes entry for one dim of a TP-ruled leaf: the largest prefix
+    of `axes` that divides the dim (fsdp first, then fsdp+pp), or None."""
+    best = None
+    for end in range(1, len(axes) + 1):
+        cand = axes[:end]
+        if dim_size % _axes_prod(mesh, cand) == 0:
+            best = cand
+    if best is None:
+        return None
+    return best if len(best) > 1 else best[0]
+
+
+def _tp_spec(path: str, leaf, data_axes: Tuple[str, ...], mesh: Mesh) -> Optional[PartitionSpec]:
     """Megatron-style TP placement by parameter path; None = no TP rule."""
     if leaf.ndim == 2:
         if "qkv/w" in path or "w1/w" in path:
-            return P(fsdp, AXIS_TP)  # column parallel
+            return P(_data_slot(leaf.shape[0], data_axes, mesh), AXIS_TP)  # column parallel
         if ("shared_attn" in path and "out/w" in path) or "w2/w" in path:
-            return P(AXIS_TP, fsdp)  # row parallel
+            return P(AXIS_TP, _data_slot(leaf.shape[1], data_axes, mesh))  # row parallel
         if "logits_linear/w" in path:
-            return P(fsdp, AXIS_TP)  # vocab-sharded output projection
+            return P(_data_slot(leaf.shape[0], data_axes, mesh), AXIS_TP)  # vocab-sharded output projection
     if leaf.ndim == 1:
         if "w1/b" in path or "logits_linear/b" in path:
             return P(AXIS_TP)
@@ -70,14 +111,12 @@ def _tp_spec(path: str, leaf, fsdp: Optional[str]) -> Optional[PartitionSpec]:
 
 
 def _rule(path: str, leaf, mesh: Mesh, zero_stage: int, tensor_parallel: bool, params_sharded: bool):
-    fsdp = AXIS_FSDP if params_sharded else None
+    axes = _data_axes(mesh, include_fsdp=params_sharded)
     if tensor_parallel:
-        tp = _tp_spec(path, leaf, fsdp)
+        tp = _tp_spec(path, leaf, axes, mesh)
         if tp is not None:
             return tp
-    if params_sharded:
-        return _shard_largest(leaf, AXIS_FSDP, mesh)
-    return P()
+    return _shard_largest(leaf, axes, mesh)
 
 
 def param_specs(params: Any, mesh: Mesh, zero_stage: int = 0, tensor_parallel: Optional[bool] = None):
@@ -108,7 +147,7 @@ def opt_state_specs(opt_state: Any, mesh: Mesh, zero_stage: int = 0, tensor_para
         p = _path_str(path)
         spec = _rule(p, leaf, mesh, zero_stage, tensor_parallel, params_sharded)
         if spec == P() and moments_sharded:
-            return _shard_largest(leaf, AXIS_FSDP, mesh)
+            return _shard_largest(leaf, _data_axes(mesh, include_fsdp=True), mesh)
         return spec
 
     return jax.tree_util.tree_map_with_path(rule, opt_state)

@@ -22,7 +22,11 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# 1: named pytrees + JSON meta.  2: adds uint bit-views + __dtypes_ sidecar
+# for accelerator dtypes (bf16/fp8).  The version is stamped into the file and
+# checked on load so a loader that predates a format change fails loudly
+# instead of e.g. returning bf16 leaves as raw uint16 views.
+FORMAT_VERSION = 2
 
 
 # npz can only hold numpy-native dtypes; accelerator dtypes (bfloat16 — e.g.
@@ -36,7 +40,10 @@ def _lowp_dtype(name: str):
 
 def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> None:
     """trees: named pytrees of arrays; meta: JSON-serializable metadata."""
-    payload = {"__meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
+    payload = {
+        "__meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        "__format": np.array(FORMAT_VERSION, dtype=np.int64),
+    }
     for name, tree in trees.items():
         if tree is None:
             continue
@@ -67,6 +74,12 @@ def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> N
 def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Returns (trees, meta)."""
     with np.load(path, allow_pickle=False) as data:
+        fmt = int(data["__format"]) if "__format" in data.files else 1
+        if fmt > FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path!r} has format version {fmt}, newer than this "
+                f"loader's {FORMAT_VERSION}; upgrade the library to read it"
+            )
         meta = json.loads(bytes(data["__meta"]).decode())
         names = {
             k[len("__treedef_") :] for k in data.files if k.startswith("__treedef_")
@@ -92,12 +105,18 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
 
 def rotate_checkpoints(directory: str, pattern: str, keep_n: Optional[int]) -> None:
     """Delete the oldest checkpoints matching `pattern` (a glob) so at most
-    keep_n remain."""
+    keep_n remain.  Handles both single-file (npz) and directory (orbax
+    sharded) checkpoints."""
     if keep_n is None or keep_n <= 0:
         return
     files = sorted(Path(directory).glob(pattern), key=lambda p: p.stat().st_mtime)
     for old in files[:-keep_n]:
-        old.unlink()
+        if old.is_dir():
+            import shutil
+
+            shutil.rmtree(old)
+        else:
+            old.unlink()
 
 
 def to_host(tree: Any) -> Any:
@@ -119,14 +138,26 @@ def save_sharded(directory: str, state: Any, meta: Optional[Dict[str, Any]] = No
         (path / "meta.json").write_text(json.dumps(meta))
 
 
-def load_sharded(directory: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
+def load_sharded(directory: str, template: Any = None) -> Tuple[Any, Dict[str, Any]]:
     """Restore into `template`'s structure/shardings (abstract arrays with
-    shardings re-shard onto the current mesh)."""
+    shardings re-shard onto the current — possibly differently shaped — mesh;
+    sharding is a property of the restore mesh, not the file).  With no
+    template, the full tree is restored with its saved structure (host/default
+    device — the single-host inference path)."""
     import orbax.checkpoint as ocp
 
     path = Path(directory).absolute()
     with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(path / "state", template)
+        if template is None:
+            state = ckptr.restore(path / "state")
+        else:
+            state = ckptr.restore(path / "state", template)
     meta_file = path / "meta.json"
     meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
     return state, meta
+
+
+def is_sharded_checkpoint(path: str) -> bool:
+    """True iff `path` is an orbax sharded checkpoint directory."""
+    p = Path(path)
+    return p.is_dir() and (p / "state").exists()

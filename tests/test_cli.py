@@ -161,6 +161,77 @@ def test_train_dalle_resume(workspace, trained_dalle):
     assert rates and all(r > 0 for r in rates)
 
 
+def test_sharded_checkpoint_train_resume_generate(workspace, trained_vae):
+    """--sharded_checkpoint end to end: orbax directory save (no host
+    gather), resume from the directory (weights restored after distribution),
+    and generate.py inference straight off the directory."""
+    pytest.importorskip("orbax.checkpoint")
+    from dalle_pytorch_tpu.training.checkpoint import is_sharded_checkpoint
+
+    common = [
+        "--image_text_folder", str(workspace / "data"),
+        "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "8",
+        "--text_seq_len", "16", "--num_text_tokens", "64",
+        "--batch_size", "8", "--truncate_captions",
+        "--save_every_n_steps", "0", "--sample_every_n_steps", "0",
+        "--sharded_checkpoint",
+    ]
+    out = workspace / "dalle_sharded"
+    state, cfg = train_dalle_cli.main([
+        "--vae_path", str(trained_vae), "--epochs", "1",
+        "--dalle_output_file_name", str(out), *common,
+    ])
+    ckpt = workspace / "dalle_sharded.pt"
+    assert is_sharded_checkpoint(str(ckpt))
+    assert (ckpt / "vae.npz").exists()
+
+    out2 = workspace / "dalle_sharded_resumed"
+    state2, cfg2 = train_dalle_cli.main([
+        "--dalle_path", str(ckpt), "--epochs", "2",
+        "--dalle_output_file_name", str(out2), *common,
+    ])
+    import json
+
+    meta = json.loads((workspace / "dalle_sharded_resumed.pt" / "meta.json").read_text())
+    assert meta["epoch"] == 2
+    assert meta["global_step"] == 6  # 3 restored + 3 new
+
+    paths = generate_cli.main([
+        "--dalle_path", str(workspace / "dalle_sharded_resumed.pt"),
+        "--text", "a red circle",
+        "--num_images", "1", "--batch_size", "1",
+        "--outputs_dir", str(workspace / "outputs_sharded"),
+    ])
+    assert len(paths) == 1
+
+
+def test_rotation_glob_strips_step_suffix():
+    """Regression: the rotation glob was built from the step file's own stem
+    ('out_step100' -> 'out_step100_step*.npz'), which matched nothing, so
+    --keep_n_checkpoints silently never deleted anything."""
+    from dalle_pytorch_tpu.cli.train_dalle import _rotation_glob
+
+    assert _rotation_glob("out_step100.npz") == "out_step*.npz"
+    assert _rotation_glob("/a/b/my_run_step5.npz") == "my_run_step*.npz"
+
+
+def test_keep_n_checkpoints_rotates(workspace, trained_vae):
+    out = workspace / "dalle_rot"
+    train_dalle_cli.main([
+        "--vae_path", str(trained_vae),
+        "--image_text_folder", str(workspace / "data"),
+        "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "8",
+        "--text_seq_len", "16", "--num_text_tokens", "64",
+        "--epochs", "1", "--batch_size", "8", "--truncate_captions",
+        "--save_every_n_steps", "1", "--keep_n_checkpoints", "1",
+        "--sample_every_n_steps", "0",
+        "--dalle_output_file_name", str(out),
+    ])
+    # 3 steps -> saves at step 1 and 2; keep_n=1 leaves only the newest
+    left = sorted(p.name for p in workspace.glob("dalle_rot_step*.npz"))
+    assert left == ["dalle_rot_step2.npz"]
+
+
 def test_generate_cli(workspace, trained_dalle):
     paths = generate_cli.main([
         "--dalle_path", str(trained_dalle),
