@@ -147,10 +147,13 @@ def main():
         raise
     if watchdog_ready is not None:
         watchdog_ready.set()
-    # second watchdog over the whole TPU measurement section: a wedged
-    # remote compiler can hang any in-process TPU computation indefinitely;
-    # 40 min comfortably covers the worst legitimate run (compile + steps +
-    # two 14-min-capped flagship subprocess rows)
+    # second watchdog over the IN-PROCESS TPU measurement section only: a
+    # wedged remote compiler can hang any in-process TPU computation
+    # indefinitely; 40 min covers the dim-2048 compile + steps + the two
+    # generation jits.  The flagship subprocess rows are NOT under this
+    # clock — they carry their own 840s hard timeouts and get a separate
+    # watchdog (ADVICE r4: a slow-but-successful run must not be execve'd
+    # into a degraded CPU rerun that discards real TPU results).
     bench_done = _arm_init_watchdog(2400) if on_tpu else None
 
     from dalle_pytorch_tpu.models import dalle as dalle_mod
@@ -319,8 +322,18 @@ def main():
             "loss": row["loss"],
         }
 
-    flagship = flagship_1p7b = None
+    flagship = flagship_1p7b = numerics = None
     if on_tpu:
+        # in-process TPU section done — retire its watchdog and arm a fresh
+        # one scoped to the subprocess rows: worst legitimate path is
+        # flagship (840s) + its fallback retry (840s) + the 1.7B row (840s)
+        # + numerics smoke (1200s) + orchestration slack.  The rows' own
+        # timeouts are the real guard; this only catches the orchestration
+        # itself wedging, and must never fire on a slow-but-successful run
+        # (that would discard the TPU rows already measured — ADVICE r4)
+        if bench_done is not None:
+            bench_done.set()
+        bench_done = _arm_init_watchdog(3 * 840 + 1200 + 300)
         # free this process's HBM so the subprocess gets the full chip: drop
         # locals AND the jitted closures/executables that embed them as
         # constants (full_gen holds the whole bf16 model otherwise)
@@ -336,6 +349,31 @@ def main():
             flagship = fb
         # round-1/2 continuity row: the 1.70B dim-1280 stand-in
         flagship_1p7b = run_flagship(1280, 10, "flash", fbatch=4, param_dtype="bfloat16")
+
+        # at-scale numerics smoke (VERDICT r4 #10): 200 real adafactor steps
+        # at flagship width under bf16 storage + stochastic rounding — the
+        # loss must actually decrease, which 4-step throughput rows can't see
+        def run_numerics(timeout_s=1200):
+            import os
+            import subprocess
+            import sys
+
+            repo = os.path.dirname(os.path.abspath(__file__))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(repo, "tools", "numerics_smoke.py")],
+                    capture_output=True, text=True, timeout=timeout_s, cwd=repo, env=env,
+                )
+                line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+                return json.loads(line)
+            except subprocess.TimeoutExpired:
+                return {"error": f"timeout after {timeout_s}s"}
+            except Exception as e:
+                return {"error": repr(e)[:200]}
+
+        numerics = run_numerics()
 
     # dim-2048/depth-8 single-chip row — kept as a secondary metric; the
     # BASELINE.md:25 target is written for the 1.3B depth-64 geometry, which
@@ -356,6 +394,7 @@ def main():
         ),
         "flagship_1p3b_depth64": flagship,
         "flagship_1p7b_dim1280": flagship_1p7b,
+        "numerics_smoke": numerics,
         "backend": jax.default_backend(),
         "degraded": degraded,
     }

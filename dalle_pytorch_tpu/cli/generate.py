@@ -76,10 +76,11 @@ def main(argv=None):
 
     if is_sharded_checkpoint(str(path)):
         # orbax sharded training checkpoint (train_dalle --sharded_checkpoint):
-        # template-free restore materializes the saved structure locally
+        # template-free restore of the weights only — inference must never
+        # materialize the optimizer moments (≈2× params of host memory)
         from dalle_pytorch_tpu.training.checkpoint import load_sharded
 
-        restored, meta = load_sharded(str(path))
+        restored, meta = load_sharded(str(path), only=("weights",))
         vae_trees, vae_side_meta = load_checkpoint(str(path / "vae.npz"))
         if meta.get("version") != __version__:
             print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
@@ -87,7 +88,11 @@ def main(argv=None):
         vae_cfg = vae_registry.config_from_meta(
             vae_side_meta.get("vae_class_name", "DiscreteVAE"), vae_side_meta["vae_params"]
         )
-        params = restored["weights"]
+        from dalle_pytorch_tpu.models import dalle as dalle_mod
+
+        # template-free restore rebuilds the file's own (possibly
+        # pre-round-5) structure — migrate like the npz branch does
+        params = dalle_mod.migrate_param_layout(restored["weights"], dalle_cfg)
         vae_params = vae_trees["vae_weights"]
     elif is_torch_checkpoint(str(path)):
         # a dalle.pt trained with the torch reference — convert on load
@@ -111,7 +116,9 @@ def main(argv=None):
         vae_cfg = vae_registry.config_from_meta(
             meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
         )
-        params = trees["weights"]
+        from dalle_pytorch_tpu.models import dalle as dalle_mod
+
+        params = dalle_mod.migrate_param_layout(trees["weights"], dalle_cfg)
         vae_params = trees["vae_weights"]
 
     tokenizer = get_tokenizer(args)

@@ -35,6 +35,7 @@ from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState
 from dalle_pytorch_tpu.training.checkpoint import (
     is_sharded_checkpoint,
     load_checkpoint,
+    unflatten_like,
     load_sharded,
     rotate_checkpoints,
     save_checkpoint,
@@ -58,7 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="taming checkpoint (.ckpt); downloads the published default when omitted")
     parser.add_argument("--vqgan_config_path", type=str, default=None,
                         help="taming config yaml matching --vqgan_model_path")
-    parser.add_argument("--wds", action="store_true", help="treat image_text_folder as tar shards")
+    parser.add_argument("--wds", action="store_true",
+                        help="treat image_text_folder as tar shards: a local glob, or a "
+                             "streaming http(s)://... / gs://... URL spec with {000..NNN} "
+                             "brace expansion (e.g. 'https://host/shard-{000..009}.tar')")
     parser.add_argument("--truncate_captions", action="store_true")
     parser.add_argument("--random_resize_crop_lower_ratio", type=float, default=0.75)
     parser.add_argument("--chinese", action="store_true")
@@ -342,7 +346,9 @@ def main(argv=None):
             # weights arrive sharded after be.distribute; init placeholders
             start_params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), dalle_cfg)
         else:
-            start_params = trees["weights"]
+            # pre-round-5 checkpoints carry the fused-GEGLU / [q|k|v] qkv
+            # layouts — migrate on load (no-op when current)
+            start_params = dalle_mod.migrate_param_layout(trees["weights"], dalle_cfg)
     else:
         num_text_tokens = args.num_text_tokens or tokenizer.vocab_size
         dalle_cfg = DALLEConfig.from_vae(
@@ -387,7 +393,15 @@ def main(argv=None):
     # data
     be.check_batch_size(args.batch_size)
     if args.wds:
-        shards = sorted(glob(args.image_text_folder))
+        from dalle_pytorch_tpu.data.loader import expand_shard_spec, is_remote_shard
+
+        if is_remote_shard(args.image_text_folder):
+            # remote shard spec, e.g. https://host/shard-{000..099}.tar or
+            # gs://bucket/data-{000..511}.tar — streamed with retry +
+            # warn-and-continue (reference train_dalle.py:195-218)
+            shards = expand_shard_spec(args.image_text_folder)
+        else:
+            shards = sorted(glob(args.image_text_folder))
         assert shards, f"no tar shards match {args.image_text_folder}"
 
         def data_iter(epoch):
@@ -470,16 +484,49 @@ def main(argv=None):
     if sharded_resume is not None:
         # restore shard-by-shard onto this run's state (its shardings define
         # the placement — the save mesh may have had a different shape)
-        restored, _ = load_sharded(
-            sharded_resume,
-            {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
-        )
-        state = TrainState(restored["step"], restored["weights"], restored["opt_state"])
+        try:
+            restored, _ = load_sharded(
+                sharded_resume,
+                {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
+            )
+            state = TrainState(restored["step"], restored["weights"], restored["opt_state"])
+        except Exception:
+            # pre-round-5 sharded checkpoint: the file's structure predates
+            # the qkv/GEGLU relayout, so the template restore cannot match.
+            # Fall back to a template-free weights restore + layout
+            # migration; the optimizer state is not mechanically mappable
+            # across the relayout and starts fresh.
+            restored, _ = load_sharded(sharded_resume, only=("weights", "step"))
+            migrated = dalle_mod.migrate_param_layout(restored["weights"], dalle_cfg)
+            if migrated is restored["weights"]:
+                raise  # current layout — the failure was something real
+            print(
+                "note: sharded checkpoint predates the round-5 parameter "
+                "layout — weights migrated, optimizer state starts fresh"
+            )
+            state, step_fn, _, _ = be.distribute(
+                loss_fn=loss_fn, params=migrated, optimizer=optimizer,
+                mesh_config=mesh_cfg, settings=settings,
+            )
+            state = TrainState(jnp.asarray(restored["step"]), state.params, state.opt_state)
     elif resume_meta is not None and "opt_state" in trees:
-        state = TrainState(state.step, state.params, jax.tree_util.tree_map(
-            lambda cur, saved: jnp.asarray(saved).astype(cur.dtype) if hasattr(cur, "dtype") else saved,
-            state.opt_state, trees["opt_state"],
-        ))
+        # v3 files return optimizer states as a TreeBundle (no pickled node
+        # types in the file) — this run's freshly-initialized opt_state is
+        # the structure template
+        try:
+            saved_opt = unflatten_like(state.opt_state, trees["opt_state"])
+        except ValueError as e:
+            # a pre-round-5 opt_state (fused-w1 moment leaves) cannot map
+            # onto the split-GEGLU template — weights already migrated;
+            # momentum restarts rather than aborting the resume
+            print(f"note: optimizer state not restored ({e}); starting fresh "
+                  "optimizer (weights restored + migrated)")
+            saved_opt = None
+        if saved_opt is not None:
+            state = TrainState(state.step, state.params, jax.tree_util.tree_map(
+                lambda cur, saved: jnp.asarray(saved).astype(cur.dtype) if hasattr(cur, "dtype") else saved,
+                state.opt_state, saved_opt,
+            ))
 
     logger = MetricLogger(
         run_name=args.dalle_output_file_name, use_wandb=args.wandb,
@@ -524,7 +571,9 @@ def main(argv=None):
             # async host->device transfer, overlapping decode + DMA with the
             # running step (the reference's DataLoader workers + async .cuda())
             batches = prefetch_to_device(batches, size=args.prefetch_batches)
+        epoch_batches = 0
         for device_batch in batches:
+            epoch_batches += 1
             key, sk = jax.random.split(key)
             device_batch = {
                 "text": jnp.asarray(device_batch["text"]),
@@ -560,6 +609,19 @@ def main(argv=None):
                     logger.finish()
                     return state, dalle_cfg
             global_step += 1
+
+        if epoch_batches == 0:
+            # a local-glob spec fails fast at the `assert shards` above, but
+            # remote --wds URLs expand unconditionally and dead shards are
+            # warn-and-continue'd per shard — without this, a typo'd URL
+            # spec would "train" through every epoch in seconds and save an
+            # untrained model (code-review finding, round 5)
+            raise RuntimeError(
+                f"epoch {epoch} produced ZERO batches from "
+                f"{args.image_text_folder!r} — every shard failed to stream "
+                "(see '[tar pipeline] skipping' warnings above) or the "
+                "dataset is smaller than one batch"
+            )
 
         if save_here:
             save(out_file, epoch + 1)

@@ -334,6 +334,101 @@ def _warn_and_continue(exn: Exception, name: str):
     print(f"[tar pipeline] skipping {name}: {exn!r}")
 
 
+def expand_shard_spec(spec: str) -> List[str]:
+    """WebDataset-style brace expansion: `{000..012}` numeric ranges (width
+    preserved from the left endpoint) and `{a,b,c}` comma lists, possibly
+    several per spec.  A spec without braces expands to itself."""
+    import re
+
+    m = re.search(r"\{([^{}]*)\}", spec)
+    if m is None:
+        return [spec]
+    head, tail = spec[: m.start()], spec[m.end() :]
+    body = m.group(1)
+    rng = re.fullmatch(r"(\d+)\.\.(\d+)", body)
+    if rng:
+        lo, hi = rng.group(1), rng.group(2)
+        width = len(lo)
+        parts = [str(i).zfill(width) for i in range(int(lo), int(hi) + 1)]
+    elif "," in body:
+        parts = body.split(",")
+    else:
+        parts = [body]
+    return [e for p in parts for e in expand_shard_spec(head + p + tail)]
+
+
+def _open_remote(url: str, retries: int, timeout: float):
+    """File-like stream for one remote shard.  http(s) via urllib with
+    bounded retries + backoff; gs:// via a `gsutil cat` pipe (the tool the
+    reference's `pipe:gsutil cat {url} || true` wds spec shells out to,
+    /root/reference/train_dalle.py:218).  Raises on final failure — the
+    caller's handler absorbs it (warn-and-continue)."""
+    if url.startswith(("http://", "https://")):
+        import urllib.request
+
+        last: Optional[Exception] = None
+        attempts = max(retries, 1)
+        for attempt in range(attempts):
+            try:
+                return urllib.request.urlopen(
+                    urllib.request.Request(url), timeout=timeout
+                )
+            except Exception as e:  # noqa: BLE001 — retry any transport error
+                last = e
+                if attempt < attempts - 1:  # no pointless backoff after the last try
+                    import time
+
+                    time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+        raise last
+    if url.startswith("gs://"):
+        import subprocess
+
+        class _GsutilStream:
+            """gsutil pipe that reaps the child and surfaces its real error
+            on close (a DEVNULL'd, never-wait()ed child would turn auth/404
+            failures into misleading 'truncated tar' warnings and leave one
+            zombie per shard).  stderr is drained by a background thread —
+            a chatty child filling the stderr pipe buffer would otherwise
+            block its stdout writes and hang the data pipeline."""
+
+            def __init__(self, u):
+                self._proc = subprocess.Popen(
+                    ["gsutil", "cat", u],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+                self._url = u
+                self._stderr_tail: list = []
+
+                def drain():
+                    for line in self._proc.stderr:
+                        self._stderr_tail.append(line)
+                        del self._stderr_tail[:-20]  # keep the last lines only
+
+                self._drainer = threading.Thread(target=drain, daemon=True)
+                self._drainer.start()
+
+            def read(self, *a):
+                return self._proc.stdout.read(*a)
+
+            def close(self):
+                self._proc.stdout.close()
+                rc = self._proc.wait()
+                self._drainer.join(timeout=5)
+                if rc != 0:
+                    tail = b"".join(self._stderr_tail).decode(errors="replace").strip()
+                    raise OSError(
+                        f"gsutil cat {self._url} exited {rc}: {tail[-300:]}"
+                    )
+
+        return _GsutilStream(url)
+    raise ValueError(f"unsupported shard url scheme: {url}")
+
+
+def is_remote_shard(shard: str) -> bool:
+    return shard.startswith(("http://", "https://", "gs://"))
+
+
 def iterate_tar_shards(
     shards: Sequence[str],
     image_size: int,
@@ -347,44 +442,114 @@ def iterate_tar_shards(
     handler: Callable = _warn_and_continue,
     seed: int = 0,
     num_workers: int = 0,
+    fetcher: Optional[Callable] = None,
+    retries: int = 3,
+    timeout: float = 60.0,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """Stream (text_tokens, image_array) pairs from .tar shards, grouping
-    members by basename like WebDataset; shards are split across processes.
+    """Stream (text_tokens, image_array) pairs from .tar shards — local paths
+    or http(s):// / gs:// URLs — grouping adjacent members by basename like
+    WebDataset; shards are split across processes.  Tars are read in
+    streaming mode (`r|*`), so a remote shard is consumed as it downloads
+    with no temp file; a shard that fails to open (after `retries` for http)
+    or dies mid-stream is reported to `handler` and the stream continues
+    with the next shard (the reference's `pipe:curl ... || true` +
+    warn_and_continue resilience, /root/reference/train_dalle.py:364-423).
     num_workers > 0 moves JPEG decode + crop + tokenize onto a thread pool
     (tar byte reads stay serial — tarfile handles are not thread-safe);
-    per-item rngs keep output identical to the serial path."""
+    per-item rngs keep output identical to the serial path.  `fetcher`
+    overrides the remote opener (tests inject flaky transports)."""
+    open_remote = fetcher or (lambda url: _open_remote(url, retries, timeout))
+
+    def sample_entry(shard, stem, members):
+        img_bytes = None
+        for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
+            if ext in members:
+                img_bytes = members[ext]
+                break
+        if img_bytes is None or caption_key not in members:
+            return None
+        return f"{shard}:{stem}", members[caption_key], img_bytes
+
+    def local_entries(tf, shard) -> Iterator[Tuple[str, bytes, bytes]]:
+        """Seekable shard: whole-archive grouping — members of a sample may
+        appear anywhere in the tar (e.g. `tar cf shard.tar *.jpg *.txt`).
+        Only the winning image member and the caption are read — samples
+        with sidecar files (.json metadata, alternate encodings) must not
+        pay I/O for bytes the pipeline never uses."""
+        samples: dict = {}
+        for member in tf.getmembers():
+            if not member.isfile():
+                continue
+            stem, _, ext = member.name.rpartition(".")
+            samples.setdefault(stem, {})[ext.lower()] = member
+        for stem, members in samples.items():
+            img_member = None
+            for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
+                if ext in members:
+                    img_member = members[ext]
+                    break
+            if img_member is None or caption_key not in members:
+                continue
+            try:
+                caption_bytes = tf.extractfile(members[caption_key]).read()
+                img_bytes = tf.extractfile(img_member).read()
+            except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+                handler(e, f"{shard}:{stem}")
+                continue
+            yield f"{shard}:{stem}", caption_bytes, img_bytes
+
+    def stream_entries(tf, shard) -> Iterator[Tuple[str, bytes, bytes]]:
+        """Non-seekable remote stream: WebDataset adjacency grouping (a
+        sample's members are consecutive — the format's convention)."""
+        stem_now: Optional[str] = None
+        members: dict = {}
+        try:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                stem, _, ext = member.name.rpartition(".")
+                if stem != stem_now and stem_now is not None:
+                    entry = sample_entry(shard, stem_now, members)
+                    if entry is not None:
+                        yield entry
+                    members = {}
+                stem_now = stem
+                members[ext.lower()] = tf.extractfile(member).read()
+        except (OSError, tarfile.TarError, EOFError) as e:
+            # truncated download / corrupt shard mid-stream: keep what was
+            # already grouped, move on to the next shard
+            handler(e, shard)
+        if stem_now is not None:
+            entry = sample_entry(shard, stem_now, members)
+            if entry is not None:
+                yield entry
 
     def raw_entries() -> Iterator[Tuple[str, bytes, bytes, int]]:
         counter = 0
         for shard in list(shards)[process_index::process_count]:
             try:
-                tf = tarfile.open(shard)
-            except (OSError, tarfile.TarError) as e:
+                if is_remote_shard(shard):
+                    stream = open_remote(shard)
+                    tf = tarfile.open(fileobj=stream, mode="r|*")
+                    entries = stream_entries(tf, shard)
+                else:
+                    stream = None
+                    tf = tarfile.open(shard)
+                    entries = local_entries(tf, shard)
+            except Exception as e:  # noqa: BLE001 — warn_and_continue parity
                 handler(e, shard)
                 continue
-            with tf:
-                samples: dict = {}
-                for member in tf.getmembers():
-                    if not member.isfile():
-                        continue
-                    stem, _, ext = member.name.rpartition(".")
-                    samples.setdefault(stem, {})[ext.lower()] = member
-                for stem, members in samples.items():
-                    img_member = None
-                    for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
-                        if ext in members:
-                            img_member = members[ext]
-                            break
-                    if img_member is None or caption_key not in members:
-                        continue
-                    try:
-                        caption_bytes = tf.extractfile(members[caption_key]).read()
-                        img_bytes = tf.extractfile(img_member).read()
-                    except Exception as e:  # noqa: BLE001 — warn_and_continue parity
-                        handler(e, f"{shard}:{stem}")
-                        continue
-                    yield f"{shard}:{stem}", caption_bytes, img_bytes, counter
+            try:
+                for entry in entries:
+                    yield (*entry, counter)
                     counter += 1
+            finally:
+                tf.close()
+                if stream is not None:
+                    try:
+                        stream.close()  # surfaces the transport's real error
+                    except Exception as e:  # noqa: BLE001 — warn-and-continue
+                        handler(e, shard)
 
     def decode(entry):
         name, caption_bytes, img_bytes, idx = entry

@@ -146,6 +146,20 @@ def tupled_hparams(hparams: dict) -> dict:
 # init
 # ---------------------------------------------------------------------------
 
+def migrate_param_layout(params: dict, cfg: DALLEConfig) -> dict:
+    """Upgrade pre-round-5 DALLE checkpoints to the tp-local transformer
+    layouts (no-op when already current) — see
+    transformer.migrate_transformer_layout."""
+    from dalle_pytorch_tpu.models.transformer import migrate_transformer_layout
+
+    migrated = migrate_transformer_layout(
+        params.get("transformer", {}), cfg.heads, cfg.dim_head
+    )
+    if migrated is params.get("transformer"):
+        return params
+    return {**params, "transformer": migrated}
+
+
 def init_dalle(key: jax.Array, cfg: DALLEConfig) -> dict:
     keys = KeyChain(key)
     params = {

@@ -130,15 +130,31 @@ def convert_dalle_state_dict(state: Dict, cfg: DALLEConfig) -> dict:
                 "scale" if rest[1] == "weight" else "bias"
             ] = jnp.asarray(_np(state[key]))
         elif rest[:2] == ["to_qkv", "weight"]:
-            shared_attn[spec.attn_id]["qkv"] = {"w": jnp.asarray(_np(state[key]).T)}
+            # reference columns are [q|k|v]-blocked; ours are head-major
+            # [h0:(q|k|v), h1:(q|k|v), ...] (transformer.py init_transformer —
+            # tp-local splits), so permute columns on import
+            w = _np(state[key]).T  # (dim, 3*h*dh)
+            h_cnt, dh = cfg.heads, cfg.dim_head
+            w = w.reshape(w.shape[0], 3, h_cnt, dh).transpose(0, 2, 1, 3).reshape(w.shape[0], -1)
+            shared_attn[spec.attn_id]["qkv"] = {"w": jnp.asarray(w)}
         elif rest[:2] == ["to_out", "0"]:
             d = shared_attn[spec.attn_id].setdefault("out", {})
             d["w" if rest[2] == "weight" else "b"] = jnp.asarray(
                 _np(state[key]).T if rest[2] == "weight" else _np(state[key])
             )
-        elif rest[0] == "net" and rest[1] in ("0", "3"):
-            name = "w1" if rest[1] == "0" else "w2"
-            d = shared_ff[spec.ff_id].setdefault(name, {})
+        elif rest[0] == "net" and rest[1] == "0":
+            # reference GEGLU is one [values|gates]-blocked projection; ours
+            # is two column-parallel matrices (w1 values, w1g gates)
+            val = _np(state[key]).T if rest[2] == "weight" else _np(state[key])
+            half = val.shape[-1] // 2
+            shared_ff[spec.ff_id].setdefault("w1", {})[
+                "w" if rest[2] == "weight" else "b"
+            ] = jnp.asarray(val[..., :half])
+            shared_ff[spec.ff_id].setdefault("w1g", {})[
+                "w" if rest[2] == "weight" else "b"
+            ] = jnp.asarray(val[..., half:])
+        elif rest[0] == "net" and rest[1] == "3":
+            d = shared_ff[spec.ff_id].setdefault("w2", {})
             d["w" if rest[2] == "weight" else "b"] = jnp.asarray(
                 _np(state[key]).T if rest[2] == "weight" else _np(state[key])
             )

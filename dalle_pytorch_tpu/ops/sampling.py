@@ -24,14 +24,16 @@ def gumbel_sample(key: jax.Array, logits: jnp.ndarray, temperature: float = 1.0,
 def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
     """Keep the top max(int((1-thres)*V), 1) logits, set the rest to -inf.
 
-    Same threshold-fraction semantics as the reference's top_k; k is static
-    (derived from the vocab size), so this jits to a single lax.top_k.  Ties at
-    the k-th value are all kept (the reference's scatter keeps exactly k; the
-    difference only matters for exactly-tied logits)."""
+    Exact parity with the reference's top_k (dalle_pytorch.py:63-69,
+    topk + scatter): EXACTLY k entries survive — ties at the k-th value are
+    broken by top_k's ordering, not all kept (a tracked round-4 micro-delta,
+    now closed).  k is static (derived from the vocab size), so this jits to
+    one lax.top_k + scatter."""
     num_logits = logits.shape[-1]
     k = max(int((1.0 - thres) * num_logits), 1)
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]
-    return jnp.where(logits < kth, -jnp.inf, logits)
+    val, ind = jax.lax.top_k(logits, k)
+    probs = jnp.full_like(logits, -jnp.inf)
+    return jnp.put_along_axis(probs, ind, val, axis=-1, inplace=False)
 
 
 def prob_mask_like(key: jax.Array, shape, prob: float) -> jnp.ndarray:

@@ -11,6 +11,7 @@ fixed-shape ring buffer.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -55,7 +56,15 @@ def token_shift(x: jnp.ndarray, seq_len: int, image_fmap_size: int) -> jnp.ndarr
     row0 = img_pos < fmap
 
     shift1 = _shift_seq(x, 1, 1)     # p-1: text shift and image 'left'
-    shiftf = _shift_seq(x, 1, fmap)  # p-fmap: image 'row above'
+    # ordering barrier between the two shifts: with the sequence dim sharded
+    # (seq_shard_axis), each shift lowers to a halo collective-permute; the
+    # two are data-independent, and XLA:CPU's async thunk executor may start
+    # them in different orders on different devices, deadlocking its
+    # in-process rendezvous (observed under sp x pp meshes).  The barrier
+    # makes the second shift depend on the first so every device issues them
+    # in the same order; on TPU (in-order execution) it costs nothing.
+    x2, _ = jax.lax.optimization_barrier((x, shift1))
+    shiftf = _shift_seq(x2, 1, fmap)  # p-fmap: image 'row above'
 
     # where each (position, channel) reads from; uncovered cells are zero
     # (the reference's zero padding at text position 0 / image row 0 / col 0)

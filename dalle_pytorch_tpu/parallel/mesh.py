@@ -16,6 +16,8 @@ one exception: the pipeline's stage-hop ppermute, which is manual by nature).
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 from typing import Optional, Sequence
 
@@ -54,11 +56,68 @@ class MeshConfig:
         return MeshConfig(dp, self.fsdp, self.tp, self.sp, self.pp)
 
 
+# Framework-owned record of the innermost `with mesh:` block.  jax keeps its
+# context mesh in private thread-resources state; rather than reaching into
+# it, every mesh built here is a ContextMesh that also registers itself on
+# enter (contextvar → survives threads spawned per context, unlike a plain
+# global).
+_ACTIVE_MESH: contextvars.ContextVar[Optional[Mesh]] = contextvars.ContextVar(
+    "dalle_tpu_active_mesh", default=None
+)
+# Mesh forbids setattr (immutable), so enter/exit tokens live in a
+# context-local stack beside the contextvar rather than on the instance.
+_MESH_TOKENS: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "dalle_tpu_mesh_tokens", default=()
+)
+
+
+class ContextMesh(Mesh):
+    """`jax.sharding.Mesh` that additionally publishes itself to
+    `active_mesh()` while entered, so model code can discover the ambient
+    mesh through a public, framework-owned channel."""
+
+    def __enter__(self):
+        token = _ACTIVE_MESH.set(self)
+        _MESH_TOKENS.set(_MESH_TOKENS.get() + (token,))
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        tokens = _MESH_TOKENS.get()
+        _MESH_TOKENS.set(tokens[:-1])
+        _ACTIVE_MESH.reset(tokens[-1])
+        return super().__exit__(*exc)
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The innermost entered ContextMesh, or — for users driving jax's own
+    mesh plumbing — the mesh installed via `jax.sharding.set_mesh`."""
+    mesh = _ACTIVE_MESH.get()
+    if mesh is not None:
+        return mesh
+    mesh = jax.sharding.get_mesh()
+    return None if mesh.empty else mesh
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    """Enter `mesh` AND publish it to `active_mesh()`.  Use this (not a bare
+    `with mesh:`) when the mesh may be a plain `jax.sharding.Mesh` a user
+    built themselves — a ContextMesh publishes itself, a plain Mesh does
+    not, and model code (ring attention, pipeline engagement) discovers the
+    ambient mesh through `active_mesh()`."""
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
 def make_mesh(cfg: MeshConfig = MeshConfig(), devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     cfg = cfg.resolve(len(devices))
     arr = np.asarray(devices).reshape(cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.pp)
-    return Mesh(arr, MESH_AXES)
+    return ContextMesh(arr, MESH_AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -98,14 +98,14 @@ def _data_slot(dim_size: int, axes: Tuple[str, ...], mesh: Mesh):
 def _tp_spec(path: str, leaf, data_axes: Tuple[str, ...], mesh: Mesh) -> Optional[PartitionSpec]:
     """Megatron-style TP placement by parameter path; None = no TP rule."""
     if leaf.ndim == 2:
-        if "qkv/w" in path or "w1/w" in path:
+        if "qkv/w" in path or "w1/w" in path or "w1g/w" in path:
             return P(_data_slot(leaf.shape[0], data_axes, mesh), AXIS_TP)  # column parallel
         if ("shared_attn" in path and "out/w" in path) or "w2/w" in path:
             return P(AXIS_TP, _data_slot(leaf.shape[1], data_axes, mesh))  # row parallel
         if "logits_linear/w" in path:
             return P(_data_slot(leaf.shape[0], data_axes, mesh), AXIS_TP)  # vocab-sharded output projection
     if leaf.ndim == 1:
-        if "w1/b" in path or "logits_linear/b" in path:
+        if "w1/b" in path or "w1g/b" in path or "logits_linear/b" in path:
             return P(AXIS_TP)
     return None
 

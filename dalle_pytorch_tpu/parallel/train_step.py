@@ -232,8 +232,12 @@ def make_train_step(
 
     def with_mesh_ctx(state, batch, key):
         # mesh in context during trace + dispatch so models can use raw
-        # PartitionSpec constraints (e.g. the transformer's seq_shard_axis)
-        with mesh:
+        # PartitionSpec constraints (e.g. the transformer's seq_shard_axis);
+        # mesh_context also publishes plain user-built Meshes to
+        # active_mesh(), which ring attention / pipeline engagement read
+        from dalle_pytorch_tpu.parallel.mesh import mesh_context
+
+        with mesh_context(mesh):
             return jitted(state, batch, key)
 
     return init_fn, with_mesh_ctx

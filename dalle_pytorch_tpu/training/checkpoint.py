@@ -13,20 +13,25 @@ For very large multi-host runs, orbax can replace the npz container behind
 the same API (save/load names + meta)."""
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-import pickle
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-# 1: named pytrees + JSON meta.  2: adds uint bit-views + __dtypes_ sidecar
-# for accelerator dtypes (bf16/fp8).  The version is stamped into the file and
-# checked on load so a loader that predates a format change fails loudly
-# instead of e.g. returning bf16 leaves as raw uint16 views.
-FORMAT_VERSION = 2
+# 1: named pytrees + JSON meta + pickled treedefs; bf16 uint bit-views and the
+# __dtypes_ sidecar shipped while the stamp was still 1, so v1 files may or
+# may not carry them.  2: adds only the version stamp/check itself, so a
+# loader that predates a format change fails loudly instead of e.g. returning
+# bf16 leaves as raw uint16 views.  3: replaces pickled treedefs with JSON key
+# paths + a pure-container structure descriptor — loading a v3 checkpoint
+# never unpickles, so an untrusted file cannot execute code.  The v3 loader
+# still reads v1/v2 (their treedefs need pickle; only load those from trusted
+# sources).
+FORMAT_VERSION = 3
 
 
 # npz can only hold numpy-native dtypes; accelerator dtypes (bfloat16 — e.g.
@@ -38,6 +43,124 @@ def _lowp_dtype(name: str):
     return np.dtype(getattr(ml_dtypes, name))
 
 
+# --- v3 structure encoding ---------------------------------------------------
+#
+# A tree's structure is stored as (a) per-leaf key paths — always, for
+# diagnostics and template verification — and (b) a nested JSON descriptor
+# when the tree is built purely of dict/list/tuple/None containers, which
+# lets it be reconstructed with no type information beyond JSON itself.
+# Trees with library node types (optax's named-tuple states) are returned as
+# a TreeBundle and must be restored into a caller-built template via
+# `unflatten_like` — the template supplies the node types, the file supplies
+# only array bytes + paths, and nothing in the file can name a Python class.
+
+def _encode_paths(paths) -> List[List]:
+    ju = jax.tree_util
+    out = []
+    for path in paths:
+        segs = []
+        for p in path:
+            if isinstance(p, ju.DictKey):
+                segs.append(["k", p.key if isinstance(p.key, (str, int)) else str(p.key)])
+            elif isinstance(p, ju.SequenceKey):
+                segs.append(["i", p.idx])
+            elif isinstance(p, ju.GetAttrKey):
+                segs.append(["a", p.name])
+            elif isinstance(p, ju.FlattenedIndexKey):
+                segs.append(["f", p.key])
+            else:
+                segs.append(["r", str(p)])
+        out.append(segs)
+    return out
+
+
+class _NotPure(Exception):
+    pass
+
+
+def _pure_struct(tree, counter) -> Any:
+    """JSON descriptor for a pure-container tree; leaves become their flatten
+    index.  Raises _NotPure on any library node type (namedtuples included —
+    reconstructing those from a file would mean importing classes by name)."""
+    if tree is None:
+        return {"_": "none"}
+    if isinstance(tree, dict) and type(tree) is dict:
+        if not all(isinstance(k, (str, int)) for k in tree):
+            raise _NotPure
+        # flatten order for dicts is sorted-key order — encode in that order
+        # but preserve original keys (JSON objects keep insertion order)
+        return {"_": "dict", "items": [[k, _pure_struct(tree[k], counter)] for k in sorted(tree)]}
+    if type(tree) is list:
+        return {"_": "list", "items": [_pure_struct(v, counter) for v in tree]}
+    if type(tree) is tuple:
+        return {"_": "tuple", "items": [_pure_struct(v, counter) for v in tree]}
+    if jax.tree_util.treedef_is_leaf(jax.tree_util.tree_structure(tree)):
+        i = counter[0]
+        counter[0] += 1
+        return {"_": "leaf", "i": i}
+    raise _NotPure
+
+
+def _rebuild_pure(desc, leaves):
+    kind = desc["_"]
+    if kind == "none":
+        return None
+    if kind == "leaf":
+        return leaves[desc["i"]]
+    if kind == "dict":
+        return {k: _rebuild_pure(v, leaves) for k, v in desc["items"]}
+    if kind == "list":
+        return [_rebuild_pure(v, leaves) for v in desc["items"]]
+    if kind == "tuple":
+        return tuple(_rebuild_pure(v, leaves) for v in desc["items"])
+    raise ValueError(f"unknown structure node {kind!r}")
+
+
+@dataclasses.dataclass
+class TreeBundle:
+    """Leaves + key paths of a tree whose node types live in library code
+    (e.g. an optax optimizer state).  Restore with `unflatten_like(template,
+    bundle)` — the caller's template provides the structure."""
+
+    paths: List[List]
+    leaves: List[Any]
+
+
+def unflatten_like(template: Any, saved: Any) -> Any:
+    """Restore `saved` (a TreeBundle, or any pytree with matching leaf
+    count/order) into `template`'s exact structure.  For TreeBundles the
+    stored key paths are checked against the template's so a file from a
+    different optimizer/model fails loudly instead of silently transposing
+    leaves."""
+    tpl_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if isinstance(saved, TreeBundle):
+        leaves = saved.leaves
+        want = _encode_paths([p for p, _ in tpl_paths])
+        if len(leaves) != len(tpl_paths) or want != saved.paths:
+            raise ValueError(
+                f"checkpoint tree does not match template: "
+                f"{len(leaves)} leaves vs {len(tpl_paths)} in template"
+                + next(
+                    (f"; first mismatch at leaf {i}: file {a} vs template {b}"
+                     for i, (a, b) in enumerate(zip(saved.paths, want)) if a != b),
+                    "",
+                )
+            )
+    else:
+        # v1/v2 trees carry their full (pickled) structure — require exact
+        # equality, like the tree_map restore this replaced: a same-arity but
+        # differently-shaped tree must not silently assign moments to the
+        # wrong parameters by flatten position
+        saved_def = jax.tree_util.tree_structure(saved)
+        if saved_def != treedef:
+            raise ValueError(
+                f"checkpoint tree structure does not match template:\n"
+                f"  file:     {saved_def}\n  template: {treedef}"
+            )
+        leaves = jax.tree_util.tree_leaves(saved)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> None:
     """trees: named pytrees of arrays; meta: JSON-serializable metadata."""
     payload = {
@@ -47,8 +170,18 @@ def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> N
     for name, tree in trees.items():
         if tree is None:
             continue
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        payload[f"__treedef_{name}"] = np.frombuffer(pickle.dumps(treedef), dtype=np.uint8)
+        with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = [leaf for _, leaf in with_path]
+        payload[f"__paths_{name}"] = np.frombuffer(
+            json.dumps(_encode_paths([p for p, _ in with_path])).encode(), dtype=np.uint8
+        )
+        try:
+            struct = _pure_struct(tree, [0])
+            payload[f"__struct_{name}"] = np.frombuffer(
+                json.dumps(struct).encode(), dtype=np.uint8
+            )
+        except _NotPure:
+            pass  # restored via unflatten_like(template, TreeBundle)
         dtypes = []
         for i, leaf in enumerate(leaves):
             arr = np.asarray(leaf)
@@ -71,8 +204,29 @@ def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> N
     os.replace(tmp, path)
 
 
+def _load_leaves(data, name: str, n: int) -> List[np.ndarray]:
+    dkey = f"__dtypes_{name}"
+    dtypes = (
+        json.loads(bytes(data[dkey]).decode()) if dkey in data.files else [None] * n
+    )
+    leaves = []
+    for i in range(n):
+        leaf = data[f"{name}:{i}"]
+        want = dtypes[i]
+        if want is not None and leaf.dtype.name != want:
+            leaf = leaf.view(_lowp_dtype(want))  # uint bit-view back
+        leaves.append(leaf)
+    return leaves
+
+
 def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-    """Returns (trees, meta)."""
+    """Returns (trees, meta).
+
+    v3 files load without any unpickling: pure-container trees (all weights
+    trees) come back with their exact structure; library-structured trees
+    (optimizer states) come back as TreeBundle — pass those through
+    `unflatten_like(template, bundle)`.  v1/v2 files carry pickled treedefs
+    and are only safe to load from trusted sources."""
     with np.load(path, allow_pickle=False) as data:
         fmt = int(data["__format"]) if "__format" in data.files else 1
         if fmt > FORMAT_VERSION:
@@ -81,25 +235,30 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
                 f"loader's {FORMAT_VERSION}; upgrade the library to read it"
             )
         meta = json.loads(bytes(data["__meta"]).decode())
-        names = {
-            k[len("__treedef_") :] for k in data.files if k.startswith("__treedef_")
-        }
-        trees = {}
-        for name in names:
-            treedef = pickle.loads(bytes(data[f"__treedef_{name}"]))
-            n = treedef.num_leaves
-            dkey = f"__dtypes_{name}"
-            dtypes = (
-                json.loads(bytes(data[dkey]).decode()) if dkey in data.files else [None] * n
-            )
-            leaves = []
-            for i in range(n):
-                leaf = data[f"{name}:{i}"]
-                want = dtypes[i]
-                if want is not None and leaf.dtype.name != want:
-                    leaf = leaf.view(_lowp_dtype(want))  # uint8 bit-view back
-                leaves.append(leaf)
-            trees[name] = jax.tree_util.tree_unflatten(treedef, leaves)
+        trees: Dict[str, Any] = {}
+        if fmt >= 3:
+            names = {
+                k[len("__paths_") :] for k in data.files if k.startswith("__paths_")
+            }
+            for name in names:
+                paths = json.loads(bytes(data[f"__paths_{name}"]).decode())
+                leaves = _load_leaves(data, name, len(paths))
+                skey = f"__struct_{name}"
+                if skey in data.files:
+                    struct = json.loads(bytes(data[skey]).decode())
+                    trees[name] = _rebuild_pure(struct, leaves)
+                else:
+                    trees[name] = TreeBundle(paths, leaves)
+        else:
+            import pickle  # legacy formats only (see docstring)
+
+            names = {
+                k[len("__treedef_") :] for k in data.files if k.startswith("__treedef_")
+            }
+            for name in names:
+                treedef = pickle.loads(bytes(data[f"__treedef_{name}"]))
+                leaves = _load_leaves(data, name, treedef.num_leaves)
+                trees[name] = jax.tree_util.tree_unflatten(treedef, leaves)
     return trees, meta
 
 
@@ -138,20 +297,42 @@ def save_sharded(directory: str, state: Any, meta: Optional[Dict[str, Any]] = No
         (path / "meta.json").write_text(json.dumps(meta))
 
 
-def load_sharded(directory: str, template: Any = None) -> Tuple[Any, Dict[str, Any]]:
+def load_sharded(
+    directory: str, template: Any = None, only: Optional[Tuple[str, ...]] = None
+) -> Tuple[Any, Dict[str, Any]]:
     """Restore into `template`'s structure/shardings (abstract arrays with
     shardings re-shard onto the current — possibly differently shaped — mesh;
     sharding is a property of the restore mesh, not the file).  With no
     template, the full tree is restored with its saved structure (host/default
-    device — the single-host inference path)."""
+    device — the single-host inference path).
+
+    `only` (template-free path): restore just these top-level items.  The
+    partial template is built from the checkpoint's own metadata, so e.g.
+    inference can read `weights` without materializing the optimizer moments
+    (≈2× params of dead host memory at billion-param scale — ADVICE r4)."""
     import orbax.checkpoint as ocp
 
     path = Path(directory).absolute()
-    with ocp.StandardCheckpointer() as ckptr:
-        if template is None:
-            state = ckptr.restore(path / "state")
-        else:
-            state = ckptr.restore(path / "state", template)
+    if template is None and only is not None:
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+            saved = ckptr.metadata(path / "state").item_metadata.tree
+            missing = [k for k in only if k not in saved]
+            if missing:
+                raise KeyError(f"checkpoint {path} has no items {missing}; has {list(saved)}")
+            partial = jax.tree_util.tree_map(
+                lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+                {k: saved[k] for k in only},
+            )
+            state = ckptr.restore(
+                path / "state",
+                args=ocp.args.PyTreeRestore(item=partial, partial_restore=True),
+            )
+    else:
+        with ocp.StandardCheckpointer() as ckptr:
+            if template is None:
+                state = ckptr.restore(path / "state")
+            else:
+                state = ckptr.restore(path / "state", template)
     meta_file = path / "meta.json"
     meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
     return state, meta

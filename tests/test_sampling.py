@@ -256,3 +256,15 @@ def test_bf16_sampling():
     out = np.asarray(sample_image_codes(p16, cfg, text, jax.random.PRNGKey(0)))
     assert out.shape == (2, cfg.image_seq_len)
     assert (out >= 0).all() and (out < cfg.num_image_tokens).all()
+
+
+def test_top_k_keeps_exactly_k_on_ties():
+    """Reference parity (dalle_pytorch.py:63-69): topk+scatter keeps EXACTLY
+    k entries even when the k-th value is tied (round-4 tracked micro-delta,
+    closed in round 5)."""
+    from dalle_pytorch_tpu.ops.sampling import top_k_filter
+
+    logits = jnp.asarray([[5.0, 3.0, 3.0, 3.0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0]])
+    out = np.asarray(top_k_filter(logits, thres=0.7))  # k = 3
+    assert np.isfinite(out).sum() == 3
+    assert out[0, 0] == 5.0  # the unambiguous max always survives

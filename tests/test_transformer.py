@@ -298,3 +298,41 @@ def test_remat_policies_match_sequential():
         gb = jax.grad(lambda p: jnp.sum(apply_transformer(p, cfg_r, x) ** 2))(params)
         for la, lb in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
             np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+
+def test_pre_round5_layout_migration():
+    """Pre-round-5 checkpoints (fused GEGLU w1, [q|k|v]-blocked qkv) must
+    migrate losslessly onto the tp-local layouts: migrating the inverse-
+    transformed tree reproduces the current tree bit-exactly, and a current
+    tree passes through untouched."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.transformer import (
+        TransformerConfig, init_transformer, migrate_transformer_layout,
+    )
+
+    cfg = TransformerConfig(dim=32, depth=2, heads=4, dim_head=8, seq_len=24,
+                            image_fmap_size=4)
+    new = init_transformer(jax.random.PRNGKey(0), cfg)
+
+    # build the OLD layout by inverting the round-5 transforms
+    old = {"layers": new["layers"], "shared_attn": {}, "shared_ff": {}}
+    for aid, attn in new["shared_attn"].items():
+        w = np.asarray(attn["qkv"]["w"])  # head-major (dim, h*3*dh)
+        w = w.reshape(w.shape[0], cfg.heads, 3, cfg.dim_head)
+        w = w.transpose(0, 2, 1, 3).reshape(w.shape[0], -1)  # [q|k|v]-blocked
+        old["shared_attn"][aid] = {**attn, "qkv": {"w": jnp.asarray(w)}}
+    for fid, ff in new["shared_ff"].items():
+        fused = {
+            "w": jnp.concatenate([ff["w1"]["w"], ff["w1g"]["w"]], axis=-1),
+            "b": jnp.concatenate([ff["w1"]["b"], ff["w1g"]["b"]], axis=-1),
+        }
+        old["shared_ff"][fid] = {"w1": fused, "w2": ff["w2"]}
+
+    migrated = migrate_transformer_layout(old, cfg.heads, cfg.dim_head)
+    assert jax.tree_util.tree_structure(migrated) == jax.tree_util.tree_structure(new)
+    for a, b in zip(jax.tree_util.tree_leaves(migrated), jax.tree_util.tree_leaves(new)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # already-current trees pass through by identity
+    assert migrate_transformer_layout(new, cfg.heads, cfg.dim_head) is new

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Record golden fixtures for the pretrained-VAE ports (network-gated).
+
+Run this ONCE on a machine with network access + torch:
+
+    python tools/make_pretrained_goldens.py [--cache DIR]
+
+It downloads the published weights (OpenAI dVAE encoder/decoder, taming
+VQGAN f16-1024), runs a fixed deterministic input through the TORCH side
+(ground truth), and vendors small fixtures into tests/goldens/*.npz:
+
+    image (64/256px float32) -> expected codebook indices -> expected pixels
+
+tests/test_pretrained_goldens.py then asserts the JAX ports reproduce these
+against the same converted weights, closing the VERDICT r4 gap ("parity vs
+the actual published weights") without vendoring the weights themselves.
+
+Ground-truth source, in order of preference:
+  1. the official packages (`dall_e`, `taming`) if importable — metadata
+     records `source: official`;
+  2. the in-tree torch restatements (tests/torch_vae_refs.py) loaded with
+     the PUBLISHED state dicts — still catches converter/layout errors and
+     any port bug that published weights expose; metadata records
+     `source: restatement`.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "tests"))
+
+GOLDEN_DIR = ROOT / "tests" / "goldens"
+
+
+def fixed_image(size: int) -> np.ndarray:
+    """Deterministic smooth test image in [0, 1], (1, size, size, 3) NHWC."""
+    y, x = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    r = 0.5 + 0.5 * np.sin(6.28318 * (x + 0.3))
+    g = 0.5 + 0.5 * np.cos(6.28318 * (y * 2 - x))
+    b = np.clip(x * y * 2, 0, 1)
+    img = np.stack([r, g, b], axis=-1)[None]
+    return img.astype(np.float32)
+
+
+def record_openai(cache_dir):
+    import torch
+
+    from dalle_pytorch_tpu.models.pretrained import (
+        OPENAI_VAE_DECODER_URL, OPENAI_VAE_ENCODER_URL, default_cache_dir, download,
+    )
+
+    cache = Path(cache_dir or default_cache_dir())
+    enc_path = download(OPENAI_VAE_ENCODER_URL, root=cache)
+    dec_path = download(OPENAI_VAE_DECODER_URL, root=cache)
+
+    img = fixed_image(256)
+    chw = torch.from_numpy(img.transpose(0, 3, 1, 2))
+
+    source = "official"
+    try:
+        enc = torch.load(enc_path, map_location="cpu")  # dall_e pickles the module
+        dec = torch.load(dec_path, map_location="cpu")
+        assert hasattr(enc, "forward")
+    except Exception:
+        source = "restatement"
+        from torch_vae_refs import DalleDecoderRef, DalleEncoderRef  # type: ignore
+
+        enc_sd = torch.load(enc_path, map_location="cpu")
+        dec_sd = torch.load(dec_path, map_location="cpu")
+        enc = DalleEncoderRef()
+        enc.load_state_dict(enc_sd if isinstance(enc_sd, dict) else enc_sd.state_dict())
+        dec = DalleDecoderRef()
+        dec.load_state_dict(dec_sd if isinstance(dec_sd, dict) else dec_sd.state_dict())
+
+    from dalle_pytorch_tpu.models.openai_vae import map_pixels
+
+    with torch.no_grad():
+        z = enc(torch.from_numpy(np.asarray(map_pixels(chw.numpy().transpose(0, 2, 3, 1)))).permute(0, 3, 1, 2))
+        idx = z.argmax(dim=1).reshape(1, -1).numpy()
+        one_hot = torch.nn.functional.one_hot(torch.from_numpy(idx).view(1, 32, 32), 8192)
+        one_hot = one_hot.permute(0, 3, 1, 2).float()
+        rec = dec(one_hot)
+        # published decoder emits 6 channels (mean+logvar); pixels = sigmoid of first 3
+        pix = torch.sigmoid(rec[:, :3]).permute(0, 2, 3, 1).numpy()
+
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    np.savez(
+        GOLDEN_DIR / "openai_dvae.npz",
+        image=img, indices=idx.astype(np.int32), pixels=pix.astype(np.float32),
+        source=np.frombuffer(source.encode(), dtype=np.uint8),
+    )
+    print(f"openai_dvae golden recorded (source={source})")
+
+
+def record_vqgan(cache_dir):
+    import torch
+
+    from dalle_pytorch_tpu.models.pretrained import (
+        VQGAN_CONFIG_FILENAME, VQGAN_FILENAME, VQGAN_VAE_CONFIG_URL, VQGAN_VAE_URL,
+        default_cache_dir, download, parse_taming_yaml,
+    )
+    from torch_vae_refs import VQModelRef  # type: ignore
+
+    cache = Path(cache_dir or default_cache_dir())
+    ckpt = download(VQGAN_VAE_URL, VQGAN_FILENAME, root=cache)
+    yaml = download(VQGAN_VAE_CONFIG_URL, VQGAN_CONFIG_FILENAME, root=cache)
+    config = parse_taming_yaml(str(yaml))
+
+    sd = torch.load(ckpt, map_location="cpu")["state_dict"]
+    source = "restatement"
+    try:
+        from taming.models.vqgan import VQModel  # type: ignore
+
+        model = VQModel(**config["model"]["params"])
+        source = "official"
+    except Exception:
+        from dalle_pytorch_tpu.models import vqgan as vqgan_mod
+
+        model = VQModelRef(vqgan_mod.config_from_taming_dict(config, sd))
+    model.load_state_dict(sd, strict=False)
+    model.eval()
+
+    img = fixed_image(64)
+    chw = torch.from_numpy(img.transpose(0, 3, 1, 2)) * 2 - 1
+    with torch.no_grad():
+        quant, _, (_, _, idx) = model.encode(chw)
+        rec = model.decode(quant)
+        pix = ((rec.clamp(-1, 1) + 1) / 2).permute(0, 2, 3, 1).numpy()
+
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    np.savez(
+        GOLDEN_DIR / "vqgan_f16_1024.npz",
+        image=img, indices=idx.reshape(1, -1).numpy().astype(np.int32),
+        pixels=pix.astype(np.float32),
+        source=np.frombuffer(source.encode(), dtype=np.uint8),
+    )
+    print(f"vqgan_f16_1024 golden recorded (source={source})")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache", default=None)
+    ap.add_argument("--only", choices=["openai", "vqgan"], default=None)
+    args = ap.parse_args()
+    if args.only in (None, "openai"):
+        record_openai(args.cache)
+    if args.only in (None, "vqgan"):
+        record_vqgan(args.cache)
