@@ -71,7 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
     parser.add_argument("--bf16", action="store_true", help="bf16 compute (TPU-native mixed precision)")
     parser.add_argument("--fp16", action="store_true",
-                        help="reference-compat alias: mapped to bf16 (no loss scaling needed on TPU)")
+                        help="reference-compat fp16 mode: bf16 compute + DYNAMIC loss "
+                             "scaling with overflow-skip, reproducing the DeepSpeed fp16 "
+                             "engine's behavior for parity experiments")
+    parser.add_argument("--loss_scale", type=str, default=None,
+                        help="fp16-style loss scaling: 'dynamic' or a static factor "
+                             "(e.g. 32768). bf16 on TPU does not need this; it exists "
+                             "for parity with the reference's fp16/AMP runs")
     parser.add_argument("--amp", action="store_true",
                         help="reference-compat alias: mapped to bf16")
     parser.add_argument("--wandb", action="store_true")
@@ -101,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--loss_img_weight", type=int, default=7)
     parser.add_argument("--attn_types", type=str, default="full",
                         help="comma-separated cycle of full,axial_row,axial_col,conv_like,sparse")
+    parser.add_argument("--sparse_per_head", action="store_true",
+                        help="'sparse' layers draw a random block layout PER HEAD "
+                             "(DeepSpeed sparse-attention parity); costs heads x seq^2 "
+                             "mask memory per distinct layout, and requires the "
+                             "unrolled engines (not --scan_layers)")
     parser.add_argument("--shift_tokens", help="use token shift", action="store_true")
     parser.add_argument("--rotary_emb", help="use rotary embeddings", action="store_true")
     parser.add_argument("--shared_attn_ids", type=str, default=None)
@@ -367,6 +378,7 @@ def main(argv=None):
             remat_policy=args.remat_policy,
             loss_img_weight=args.loss_img_weight,
             attn_types=tuple(args.attn_types.split(",")),
+            sparse_per_head=args.sparse_per_head,
             stable=args.stable_softmax,
             shift_tokens=args.shift_tokens,
             rotary_emb=args.rotary_emb,
@@ -463,8 +475,12 @@ def main(argv=None):
                 factor=0.5, patience=10, cooldown=10, min_scale=1e-6 / args.learning_rate
             ),
         )
-    if (args.fp16 or args.amp) and is_root:
-        print("note: --fp16/--amp map to bf16 on TPU (no loss scaling needed)")
+    if args.fp16 and is_root:
+        print("note: --fp16 runs bf16 compute + dynamic loss scaling with "
+              "overflow-skip (DeepSpeed-fp16 parity semantics)")
+    elif args.amp and is_root:
+        print("note: --amp maps to bf16 on TPU (add --loss_scale dynamic for "
+              "AMP's scaling behavior)")
     settings = StepSettings(
         grad_accum=args.ga_steps,
         compute_dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
@@ -473,6 +489,10 @@ def main(argv=None):
         # explicit float32 (not None) so resuming a bf16 checkpoint into an
         # f32 run re-materializes f32 masters rather than keeping bf16
         param_dtype=jnp.bfloat16 if args.param_dtype == "bfloat16" else jnp.float32,
+        loss_scale=(
+            args.loss_scale if args.loss_scale in (None, "dynamic")
+            else float(args.loss_scale)
+        ) if args.loss_scale is not None else ("dynamic" if args.fp16 else None),
     )
     mesh_cfg = MeshConfig(
         args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp, args.mesh_pp

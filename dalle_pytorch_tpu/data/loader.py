@@ -500,16 +500,31 @@ def iterate_tar_shards(
 
     def stream_entries(tf, shard) -> Iterator[Tuple[str, bytes, bytes]]:
         """Non-seekable remote stream: WebDataset adjacency grouping (a
-        sample's members are consecutive — the format's convention)."""
+        sample's members are consecutive — the format's convention).  A
+        shard whose groups mostly fail to pair is reported: an archive built
+        with non-adjacent members (e.g. `tar cf x.tar *.jpg *.txt`) streams
+        as zero samples here while the seekable local path would pair it,
+        and that discrepancy must be loud, not silent."""
         stem_now: Optional[str] = None
         members: dict = {}
+        complete = incomplete = 0
+
+        def flush(stem, members):
+            nonlocal complete, incomplete
+            entry = sample_entry(shard, stem, members)
+            if entry is None:
+                incomplete += 1
+                return None
+            complete += 1
+            return entry
+
         try:
             for member in tf:
                 if not member.isfile():
                     continue
                 stem, _, ext = member.name.rpartition(".")
                 if stem != stem_now and stem_now is not None:
-                    entry = sample_entry(shard, stem_now, members)
+                    entry = flush(stem_now, members)
                     if entry is not None:
                         yield entry
                     members = {}
@@ -520,9 +535,19 @@ def iterate_tar_shards(
             # already grouped, move on to the next shard
             handler(e, shard)
         if stem_now is not None:
-            entry = sample_entry(shard, stem_now, members)
+            entry = flush(stem_now, members)
             if entry is not None:
                 yield entry
+        if incomplete > max(complete, 0):
+            handler(
+                RuntimeError(
+                    f"{incomplete} of {incomplete + complete} sample groups had "
+                    "no caption+image pair — streaming requires WebDataset "
+                    "member ADJACENCY; a tar with members grouped by extension "
+                    "only pairs when read from a local (seekable) path"
+                ),
+                shard,
+            )
 
     def raw_entries() -> Iterator[Tuple[str, bytes, bytes, int]]:
         counter = 0

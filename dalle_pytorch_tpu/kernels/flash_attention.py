@@ -52,12 +52,14 @@ def resolve_block(n: int, block: int) -> int:
     return block
 
 
-def _tile_live(causal: bool, use_mask: bool, live_ref, i, j, block_q: int, block_k: int):
+def _tile_live(causal: bool, use_mask: bool, live_ref, i, j, block_q: int,
+               block_k: int, head=None):
     live = True
     if causal:
         live = j * block_k <= i * block_q + block_q - 1
     if use_mask:
-        live = jnp.logical_and(live, live_ref[i, j] > 0)
+        cell = live_ref[i, j] if head is None else live_ref[head, i, j]
+        live = jnp.logical_and(live, cell > 0)
     return live
 
 
@@ -71,7 +73,10 @@ def _masked_scores(q32, k32, mask_ref, kmask_ref, i, j, *, causal, block_q,
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(k_pos <= q_pos, s, _NEG)
     if use_mask:
-        s = jnp.where(mask_ref[:], s, _NEG)
+        m = mask_ref[:]
+        if m.ndim == 3:  # per-head mask block (1, bq, bk)
+            m = m[0]
+        s = jnp.where(m, s, _NEG)
     if use_kmask:
         # per-batch key-padding row (1, block_k) broadcast over query rows
         s = jnp.where(kmask_ref[:] > 0, s, _NEG)
@@ -84,10 +89,11 @@ def _masked_scores(q32, k32, mask_ref, kmask_ref, i, j, *, causal, block_q,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, live_ref, kmask_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, causal, block_q, block_k, scale,
-                use_mask, use_kmask):
+                use_mask, use_kmask, h, per_head):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    head = pl.program_id(0) % h if per_head else None
 
     @pl.when(j == 0)
     def _init():
@@ -112,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, live_ref, kmask_ref, o_ref, lse_r
         m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k, head))(_compute) \
         if (causal or use_mask) else _compute()
 
     @pl.when(j == nk - 1)
@@ -122,12 +128,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, live_ref, kmask_ref, o_ref, lse_r
         lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l), lse_ref.shape[1:])
 
 
-def _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k):
+def _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k,
+                      h=1, kv_grid=False):
     specs = []
     if use_mask:
+        per_head = mask.ndim == 3
         if live is None:
-            live = jnp.ones((nq, nk), jnp.int32)
-        specs.append(pl.BlockSpec((block_q, block_k), lambda b, i, j: (i, j)))
+            live = jnp.ones(
+                (mask.shape[0], nq, nk) if per_head else (nq, nk), jnp.int32
+            )
+        if per_head:
+            if kv_grid:
+                mspec = pl.BlockSpec((1, block_q, block_k), lambda bh, j, i: (bh % h, i, j))
+            else:
+                mspec = pl.BlockSpec((1, block_q, block_k), lambda bh, i, j: (bh % h, i, j))
+        else:
+            if kv_grid:
+                mspec = pl.BlockSpec((block_q, block_k), lambda b, j, i: (i, j))
+            else:
+                mspec = pl.BlockSpec((block_q, block_k), lambda b, i, j: (i, j))
+        specs.append(mspec)
         specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return specs, (mask, live)
     specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
@@ -156,20 +176,21 @@ def _flash_fwd(q, k, v, mask, live, kmask, h, causal, scale, block_q, block_k):
     nq, nk = n // block_q, n // block_k
     use_mask = mask is not None
     use_kmask = kmask is not None
+    per_head = use_mask and mask.ndim == 3
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
     ]
-    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k)
+    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k, h=h)
     in_specs += mspecs
     kspecs, kargs = _kmask_spec_arg(use_kmask, kmask, h, block_k)
     in_specs += kspecs
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
-        scale=scale, use_mask=use_mask, use_kmask=use_kmask,
+        scale=scale, use_mask=use_mask, use_kmask=use_kmask, h=h, per_head=per_head,
     )
     flops = 2 * 2 * bh * n * n * d * (0.5 if causal else 1.0)
     out, lse = pl.pallas_call(
@@ -204,10 +225,11 @@ def _flash_fwd(q, k, v, mask, live, kmask, h, causal, scale, block_q, block_k):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_ref,
                kmask_ref, dq_ref, dq_scr, *, causal, block_q, block_k, scale,
-               use_mask, use_kmask):
+               use_mask, use_kmask, h, per_head):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    head = pl.program_id(0) % h if per_head else None
 
     @pl.when(j == 0)
     def _init():
@@ -229,7 +251,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_r
             preferred_element_type=jnp.float32,
         )
 
-    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k, head))(_compute) \
         if (causal or use_mask) else _compute()
 
     @pl.when(j == nk - 1)
@@ -239,11 +261,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_r
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_ref,
                 kmask_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, causal, block_q,
-                block_k, scale, use_mask, use_kmask):
+                block_k, scale, use_mask, use_kmask, h, per_head):
     # grid: (bh, key tile j, query tile i) — accumulate over query tiles
     j = pl.program_id(1)
     i = pl.program_id(2)
     nq = pl.num_programs(2)
+    head = pl.program_id(0) % h if per_head else None
 
     @pl.when(i == 0)
     def _init():
@@ -269,7 +292,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_
             ds, q32, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
 
-    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k))(_compute) \
+    pl.when(_tile_live(causal, use_mask, live_ref, i, j, block_q, block_k, head))(_compute) \
         if (causal or use_mask) else _compute()
 
     @pl.when(i == nq - 1)
@@ -283,6 +306,7 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, kmask, h, causal, scale, block
     nq, nk = n // block_q, n // block_k
     use_mask = mask is not None
     use_kmask = kmask is not None
+    per_head = use_mask and mask.ndim == 3
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (bh, n, _LANES))
@@ -295,12 +319,13 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, kmask, h, causal, scale, block
         pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),  # lse
         pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),  # delta
     ]
-    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k)
+    mspecs, margs = _dummy_specs_args(use_mask, mask, live, nq, nk, block_q, block_k, h=h)
     kspecs, kargs = _kmask_spec_arg(use_kmask, kmask, h, block_k)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
-                          scale=scale, use_mask=use_mask, use_kmask=use_kmask),
+                          scale=scale, use_mask=use_mask, use_kmask=use_kmask,
+                          h=h, per_head=per_head),
         grid=(bh, nq, nk),
         in_specs=qkvdo_specs + mspecs + kspecs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -319,16 +344,16 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, kmask, h, causal, scale, block
         pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),  # delta
     ]
     if use_mask:
-        mspecs2 = [
-            pl.BlockSpec((block_q, block_k), lambda b, j, i: (i, j)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ]
+        mspecs2, _ = _dummy_specs_args(
+            use_mask, mask, live, nq, nk, block_q, block_k, h=h, kv_grid=True
+        )
     else:
         mspecs2 = mspecs
     kspecs2, _ = _kmask_spec_arg(use_kmask, kmask, h, block_k, kv_grid=True)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
-                          scale=scale, use_mask=use_mask, use_kmask=use_kmask),
+                          scale=scale, use_mask=use_mask, use_kmask=use_kmask,
+                          h=h, per_head=per_head),
         grid=(bh, nk, nq),
         in_specs=kv_specs + mspecs2 + kspecs2,
         out_specs=(
@@ -366,7 +391,11 @@ def _dense_recompute_grads(q, k, v, mask, kmask, h, causal, scale, lse, do):
         j_pos = jnp.arange(n)[None, :]
         s = jnp.where(j_pos <= i_pos, s, _NEG)
     if mask is not None:
-        s = jnp.where(mask[None], s, _NEG)
+        if mask.ndim == 3:  # (h, n, n) per-head: tile over the batch dim
+            b = q.shape[0] // mask.shape[0]
+            s = jnp.where(jnp.tile(mask, (b, 1, 1)), s, _NEG)
+        else:
+            s = jnp.where(mask[None], s, _NEG)
     if kmask is not None:
         s = jnp.where(jnp.repeat(kmask > 0, h, axis=0)[:, None, :], s, _NEG)
     p = jnp.exp(s - lse[:, :, :1])
@@ -431,8 +460,9 @@ def flash_attention(
     live: Optional[jnp.ndarray] = None,
     key_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """(b, h, n, d) attention.  `mask`: optional static (n, n) bool pattern
-    (True = may attend), combined with causality inside the kernel; a
+    """(b, h, n, d) attention.  `mask`: optional static (n, n) — or
+    per-head (h, n, n) — bool pattern (True = may attend), combined with
+    causality inside the kernel; a
     tile-liveness table is derived from it at trace time so fully-masked
     tiles cost nothing.  Pass `live` ((n/block_q, n/block_k) int32) explicitly
     when the mask is traced (e.g. selected per-layer inside lax.scan).
@@ -449,19 +479,29 @@ def flash_attention(
     if live is not None:
         # a caller-supplied liveness table must match the RESOLVED grid, not
         # the requested blocks (silent mismatch = out-of-bounds tile skipping)
-        assert live.shape == (n // block_q, n // block_k), (
-            f"live table {live.shape} != grid {(n // block_q, n // block_k)}; "
+        grid = (n // block_q, n // block_k)
+        want = (mask.shape[0], *grid) if (mask is not None and mask.ndim == 3) else grid
+        assert live.shape == want, (
+            f"live table {live.shape} != grid {want}; "
             f"build it at resolve_block() granularity"
         )
 
     if mask is not None and live is None:
         try:  # static masks (the normal case) yield a tile-liveness table
             mask_np = np.asarray(mask)
-            live = jnp.asarray(
-                mask_np.reshape(n // block_q, block_q, n // block_k, block_k)
-                .any(axis=(1, 3))
-                .astype(np.int32)
-            )
+            if mask_np.ndim == 3:  # per-head (h, n, n)
+                live = jnp.asarray(
+                    mask_np.reshape(mask_np.shape[0], n // block_q, block_q,
+                                    n // block_k, block_k)
+                    .any(axis=(2, 4))
+                    .astype(np.int32)
+                )
+            else:
+                live = jnp.asarray(
+                    mask_np.reshape(n // block_q, block_q, n // block_k, block_k)
+                    .any(axis=(1, 3))
+                    .astype(np.int32)
+                )
         except Exception:
             live = None  # traced mask without explicit live: no tile skipping
 

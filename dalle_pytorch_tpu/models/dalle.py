@@ -58,6 +58,7 @@ class DALLEConfig:
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
+    sparse_per_head: bool = False  # per-head random block layouts (DeepSpeed parity)
     attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
     seq_shard_axis: Optional[str] = None  # sequence-parallel mesh axis (e.g. 'sp')
     pipeline_axis: Optional[str] = None  # pipeline-parallel mesh axis (e.g. 'pp')
@@ -110,6 +111,7 @@ class DALLEConfig:
             conv_kernel_size=self.conv_kernel_size,
             conv_dilation=self.conv_dilation,
             sparse_block_size=self.sparse_block_size,
+            sparse_per_head=self.sparse_per_head,
             attn_kernel=self.attn_kernel,
             seq_shard_axis=self.seq_shard_axis,
             pipeline_axis=self.pipeline_axis,

@@ -99,6 +99,12 @@ class TransformerConfig:
     conv_dilation: int = 1
     sparse_block_size: int = 16
     sparse_num_random_blocks: Optional[int] = None
+    # per-HEAD random block layouts for 'sparse' layers (DeepSpeed's sparse
+    # attention draws a layout per head, attention.py:349-365; the default
+    # shares one layout across heads).  Mask memory is heads x seq^2 per
+    # distinct layout, so this is opt-in; unsupported with scan_layers (the
+    # scan stacks masks for EVERY layer — x heads would multiply that).
+    sparse_per_head: bool = False
 
     @property
     def inner_dim(self) -> int:
@@ -275,7 +281,11 @@ def _pattern_for(cfg: TransformerConfig, attn_type: str, seed: int = 0):
     device constant happens at the op boundary.
 
     `seed` picks the random block layout for 'sparse' (see _pattern_seed)."""
-    from dalle_pytorch_tpu.ops.masks import _block_sparse_mask_np, _pattern_mask_np
+    from dalle_pytorch_tpu.ops.masks import (
+        _block_sparse_mask_np,
+        _block_sparse_mask_np_heads,
+        _pattern_mask_np,
+    )
 
     if attn_type == "full":
         return None
@@ -283,6 +293,11 @@ def _pattern_for(cfg: TransformerConfig, attn_type: str, seed: int = 0):
         nr = cfg.sparse_num_random_blocks
         if nr is None:
             nr = cfg.seq_len // cfg.sparse_block_size // 4
+        if cfg.sparse_per_head:
+            return _block_sparse_mask_np_heads(
+                cfg.seq_len, cfg.image_fmap_size, cfg.sparse_block_size,
+                nr, 4, seed, cfg.heads,
+            )
         return _block_sparse_mask_np(
             cfg.seq_len, cfg.image_fmap_size, cfg.sparse_block_size, nr, 4, seed
         )
@@ -407,7 +422,7 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
     if _use_flash(cfg, n, key_mask):
         from dalle_pytorch_tpu.kernels.flash_attention import flash_attention
 
-        pm = pattern[:n, :n] if pattern is not None else None
+        pm = pattern[..., :n, :n] if pattern is not None else None
         km = key_mask[:, :n] if key_mask is not None else None
         out = flash_attention(
             q, k, v, mask=pm, causal=cfg.causal, scale=cfg.dim_head ** -0.5,
@@ -424,10 +439,10 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
         j = jnp.arange(n)[None, :]
         mask = j <= i
     if pattern is not None:
-        pm = pattern[:n, :n]
+        pm = pattern[..., :n, :n]  # (n, n) or per-head (h, n, n)
         mask = pm if mask is None else (mask & pm)
     if mask is not None:
-        mask = mask[None, None]
+        mask = mask[None] if mask.ndim == 3 else mask[None, None]
     if key_mask is not None:
         km = key_mask[:, None, None, :n]
         mask = km if mask is None else (mask & km)
@@ -468,8 +483,8 @@ def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask):
     j_idx = jnp.arange(n)[None, :]
     mask = j_idx <= i_idx
     if pattern is not None:
-        mask = mask & pattern[:n, :n]
-    mask = mask[None, None]
+        mask = mask & pattern[..., :n, :n]  # per-head patterns broadcast
+    mask = mask[None] if mask.ndim == 3 else mask[None, None]
     if key_mask is not None:
         mask = mask & key_mask[:, None, None, :n]
     out = attend(q, k, v, mask=mask, stable=cfg.stable)
@@ -645,6 +660,11 @@ def apply_transformer(
 
 def _assert_scannable(cfg, specs):
     assert cfg.execution in ("sequential", "remat"), "scan_layers: sequential/remat only"
+    assert not cfg.sparse_per_head, (
+        "sparse_per_head is not supported with scan_layers: the scan stacks a "
+        "mask per layer, and per-head layouts would multiply that memory by "
+        "`heads` for every layer — use the unrolled sequential/remat engines"
+    )
     assert len({s.attn_id for s in specs}) == cfg.depth and len({s.ff_id for s in specs}) == cfg.depth, (
         "scan_layers requires unshared layers (shared_attn_ids/shared_ff_ids unset)"
     )
@@ -851,9 +871,16 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset):
     j = jnp.arange(cfg.seq_len)
     mask = j <= offset
     if pattern is not None:
-        row = jax.lax.dynamic_slice(pattern, (offset, 0), (1, cfg.seq_len))[0]
-        mask = mask & row
-    out = attend(q, k_buf, v_buf, mask=mask[None, None, None, :], stable=cfg.stable)
+        if jnp.ndim(pattern) == 3:  # per-head (h, n, n): one row per head
+            rows = jax.lax.dynamic_slice(
+                pattern, (0, offset, 0), (pattern.shape[0], 1, cfg.seq_len)
+            )[:, 0]
+            mask = mask[None, :] & rows  # (h, seq)
+        else:
+            row = jax.lax.dynamic_slice(pattern, (offset, 0), (1, cfg.seq_len))[0]
+            mask = mask & row
+    amask = mask[None, :, None, :] if mask.ndim == 2 else mask[None, None, None, :]
+    out = attend(q, k_buf, v_buf, mask=amask, stable=cfg.stable)
     out = linear(shared["out"], _merge_heads(out))
     return out, (k_buf, v_buf)
 

@@ -111,6 +111,29 @@ def _block_sparse_mask_np(
     return mask[:seq_len, :seq_len]
 
 
+def _block_sparse_mask_np_heads(
+    seq_len: int,
+    image_fmap_size: int,
+    block_size: int,
+    num_random_blocks: int,
+    local_window_blocks: int,
+    seed: int,
+    heads: int,
+) -> np.ndarray:
+    """(heads, seq_len, seq_len) — one random-block stream per head (the
+    7919 stride keeps per-head seeds disjoint across layer seeds).  The
+    SINGLE source of the per-head scheme: the transformer's pattern builder
+    and the public helper below must agree or a checkpointed model's layout
+    stops being reproducible."""
+    return np.stack([
+        _block_sparse_mask_np(
+            seq_len, image_fmap_size, block_size, num_random_blocks,
+            local_window_blocks, seed + 7919 * h,
+        )
+        for h in range(heads)
+    ])
+
+
 def build_block_sparse_mask(
     seq_len: int,
     image_fmap_size: int,
@@ -118,12 +141,25 @@ def build_block_sparse_mask(
     num_random_blocks: int | None = None,
     local_window_blocks: int = 4,
     seed: int = 0,
+    heads: int | None = None,
 ) -> jnp.ndarray:
+    """(seq_len, seq_len) layout, or (heads, seq_len, seq_len) when `heads`
+    is given — each head draws its own random blocks (DeepSpeed's sparse
+    attention varies the layout per head,
+    /root/reference/dalle_pytorch/attention.py:349-365); the local window and
+    global text blocks are head-invariant."""
     if num_random_blocks is None:
         num_random_blocks = seq_len // block_size // 4
+    if heads is None:
+        return jnp.asarray(
+            _block_sparse_mask_np(
+                seq_len, image_fmap_size, block_size, num_random_blocks, local_window_blocks, seed
+            )
+        )
     return jnp.asarray(
-        _block_sparse_mask_np(
-            seq_len, image_fmap_size, block_size, num_random_blocks, local_window_blocks, seed
+        _block_sparse_mask_np_heads(
+            seq_len, image_fmap_size, block_size, num_random_blocks,
+            local_window_blocks, seed, heads,
         )
     )
 

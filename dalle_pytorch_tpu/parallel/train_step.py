@@ -64,6 +64,13 @@ class StepSettings:
     param_dtype: Any = None
     # None → stochastic rounding on iff param_dtype is low-precision.
     stochastic_round: Optional[bool] = None
+    # fp16-style loss scaling for parity experiments (SURVEY §2.2: the
+    # reference's DeepSpeed fp16 / Apex AMP path, train_dalle.py:485-491).
+    # bf16 training on TPU does not need it — this exists so reference fp16
+    # runs can be reproduced exactly.  None = off; a float = static scale;
+    # "dynamic" = DeepSpeed-style dynamic scaling (start 2^15, halve on
+    # nonfinite grads + skip the step, double after 2000 clean steps).
+    loss_scale: Optional[Any] = None
 
 
 def _stochastic_round(x32: jnp.ndarray, key: jax.Array, dtype) -> jnp.ndarray:
@@ -110,6 +117,11 @@ def make_train_step(
     step_fn(state, batch, key) -> (state, metrics); batch leaves have leading
     dim grad_accum * microbatch and are sharded over the data axes."""
 
+    ls_enabled = settings.loss_scale is not None
+    ls_dynamic = settings.loss_scale == "dynamic"
+    ls_init = 2.0 ** 15 if ls_dynamic else float(settings.loss_scale or 1.0)
+    LS_GROWTH_INTERVAL = 2000
+
     lowp = settings.param_dtype is not None and jnp.dtype(settings.param_dtype).itemsize < 4
     sr = settings.stochastic_round if settings.stochastic_round is not None else lowp
     if lowp and jnp.dtype(settings.param_dtype) != jnp.dtype(jnp.bfloat16):
@@ -132,6 +144,14 @@ def make_train_step(
             opt_state = optimizer.init(cast_floating(params, jnp.float32) if lowp else params)
         else:
             opt_state = optimizer.init(params)
+        if ls_enabled:
+            # the scale rides beside the optimizer state so no TrainState /
+            # checkpoint structure change is needed (it round-trips through
+            # the same template restore as any other opt_state leaf)
+            opt_state = (opt_state, {
+                "loss_scale": jnp.asarray(ls_init, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+            })
         state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
         if mesh is None:
             return state
@@ -145,12 +165,21 @@ def make_train_step(
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
-    def grads_and_loss(params, batch, key):
+    def grads_and_loss(params, batch, key, scale=None):
         accum = settings.grad_accum
         compute_params = cast_floating(params, settings.compute_dtype)
+        fn = loss_fn if scale is None else (
+            lambda p, b, k: loss_fn(p, b, k) * scale.astype(settings.compute_dtype)
+        )
+        inv = None if scale is None else 1.0 / scale
 
         if accum == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(compute_params, batch, key)
+            loss, grads = jax.value_and_grad(fn)(compute_params, batch, key)
+            if inv is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads
+                )
+                loss = loss * inv
             return cast_floating(grads, settings.grad_dtype), loss
 
         micro = jax.tree_util.tree_map(
@@ -161,7 +190,7 @@ def make_train_step(
         def body(carry, mb_and_key):
             g_acc, l_acc = carry
             mb, k = mb_and_key
-            loss, grads = jax.value_and_grad(loss_fn)(compute_params, mb, k)
+            loss, grads = jax.value_and_grad(fn)(compute_params, mb, k)
             g_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), g_acc, grads
             )
@@ -171,11 +200,11 @@ def make_train_step(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
         (g, l), _ = jax.lax.scan(body, (zero, 0.0), (micro, keys))
-        scale = 1.0 / accum
+        mean = (1.0 / accum) if inv is None else inv / accum
         g = jax.tree_util.tree_map(
-            lambda x: (x * scale).astype(settings.grad_dtype), g
+            lambda x: (x * mean).astype(settings.grad_dtype), g
         )
-        return g, l * scale
+        return g, l * mean
 
     # allow schedules that consume the loss (e.g. reduce_on_plateau)
     optimizer = optax.with_extra_args_support(optimizer)
@@ -184,7 +213,14 @@ def make_train_step(
         if lowp:
             # reserve a rounding key BEFORE the loss consumes the stream
             key, round_key = jax.random.split(key)
-        grads, loss = grads_and_loss(state.params, batch, key)
+        else:
+            round_key = None
+        if ls_enabled:
+            inner_opt_state, ls = state.opt_state
+            scale = ls["loss_scale"]
+        else:
+            inner_opt_state, ls, scale = state.opt_state, None, None
+        grads, loss = grads_and_loss(state.params, batch, key, scale=scale)
         # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
         # no f32 copy of the gradient buffer is materialized)
         gnorm = jnp.sqrt(sum(
@@ -197,24 +233,61 @@ def make_train_step(
                 lambda g: g * factor.astype(g.dtype), grads
             )
             gnorm = gnorm * factor  # the metric reports the applied norm
-        if lowp:
-            # optimizer math in f32 (the casts fuse into the update kernels —
-            # no resident f32 copy); storage stays low-precision via
-            # stochastic rounding
-            updates, opt_state = optimizer.update(
-                cast_floating(grads, jnp.float32), state.opt_state,
-                cast_floating(state.params, jnp.float32), value=loss,
+
+        def do_update(grads, opt_state, params, rk):
+            if lowp:
+                # optimizer math in f32 (the casts fuse into the update
+                # kernels — no resident f32 copy); storage stays
+                # low-precision via stochastic rounding
+                updates, opt_state = optimizer.update(
+                    cast_floating(grads, jnp.float32), opt_state,
+                    cast_floating(params, jnp.float32), value=loss,
+                )
+                params = _apply_updates_lowp(
+                    params, updates, rk, settings.param_dtype, sr
+                )
+            else:
+                updates, opt_state = optimizer.update(
+                    grads, opt_state, params, value=loss
+                )
+                params = optax.apply_updates(params, updates)
+            return params, opt_state
+
+        if not ls_enabled:
+            params, opt_state = do_update(grads, inner_opt_state, state.params, round_key)
+            new_state = TrainState(state.step + 1, params, opt_state)
+            metrics = {"loss": loss, "grad_norm": gnorm}
+            return new_state, metrics
+
+        # fp16-style overflow handling: a nonfinite gradient skips the step
+        # entirely and halves the scale; clean steps grow it back (dynamic)
+        finite = jnp.isfinite(gnorm)
+        args_ = (grads, inner_opt_state, state.params, round_key)
+        params, opt_state = jax.lax.cond(
+            finite,
+            lambda a: do_update(a[0], a[1], a[2], a[3]),
+            lambda a: (a[2], a[1]),
+            args_,
+        )
+        if ls_dynamic:
+            good = jnp.where(finite, ls["good_steps"] + 1, 0)
+            grow = good >= LS_GROWTH_INTERVAL
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grow, ls["loss_scale"] * 2.0, ls["loss_scale"]),
+                jnp.maximum(ls["loss_scale"] * 0.5, 1.0),
             )
-            params = _apply_updates_lowp(
-                state.params, updates, round_key, settings.param_dtype, sr
-            )
+            good = jnp.where(grow, 0, good)
         else:
-            updates, opt_state = optimizer.update(
-                grads, state.opt_state, state.params, value=loss
-            )
-            params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(state.step + 1, params, opt_state)
-        metrics = {"loss": loss, "grad_norm": gnorm}
+            new_scale = ls["loss_scale"]
+            good = ls["good_steps"]
+        new_ls = {"loss_scale": new_scale, "good_steps": good}
+        new_state = TrainState(state.step + 1, params, (opt_state, new_ls))
+        metrics = {
+            "loss": loss, "grad_norm": gnorm,
+            "loss_scale": new_scale,
+            "skipped": (~finite).astype(jnp.int32),
+        }
         return new_state, metrics
 
     if mesh is None:

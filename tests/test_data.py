@@ -370,3 +370,29 @@ def test_tar_pipeline_local_nonadjacent_members(tmp_path):
             tf.addfile(info, io.BytesIO(data))
     items = list(iterate_tar_shards([str(path)], image_size=16, text_len=16, tokenizer=TOK))
     assert len(items) == 2
+
+
+def test_tar_streaming_nonadjacent_warns(tar_shard, tmp_path, capsys):
+    """A non-adjacent archive served over a (mock) remote transport streams
+    with a LOUD adjacency diagnostic instead of silently dropping samples."""
+    path = tmp_path / "byext.tar"
+    imgs, caps = [], []
+    for i in range(2):
+        img = Image.fromarray((np.random.RandomState(i).rand(20, 20, 3) * 255).astype(np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        imgs.append((f"s{i}.jpg", buf.getvalue()))
+        caps.append((f"s{i}.txt", b"a cat"))
+    with tarfile.open(path, "w") as tf:
+        for name, data in imgs + caps:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    data = path.read_bytes()
+    items = list(iterate_tar_shards(
+        ["https://h/byext.tar"], image_size=16, text_len=16, tokenizer=TOK,
+        fetcher=lambda url: io.BytesIO(data),
+    ))
+    assert items == []
+    out = capsys.readouterr().out
+    assert "ADJACENCY" in out

@@ -680,3 +680,91 @@ def test_plain_user_mesh_visible_to_model_code():
     state = init_fn(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
     _, m = step_fn(state, batch_for(cfg), jax.random.PRNGKey(0))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_loss_scale_static_matches_unscaled():
+    """A static loss scale must be numerically transparent: scaled-then-
+    unscaled grads drive the same trajectory as no scaling (SURVEY §2.2
+    fp16-parity mode)."""
+    cfg = tiny_cfg()
+    params = jax.tree_util.tree_map(
+        np.asarray, dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    )
+    batch = batch_for(cfg, b=4)
+    opt = optax.sgd(1e-2)
+
+    init_p, step_p = make_train_step(dalle_loss(cfg), opt, settings=StepSettings())
+    init_s, step_s = make_train_step(
+        dalle_loss(cfg), opt, settings=StepSettings(loss_scale=1024.0)
+    )
+    s_p, m_p = step_p(init_p(params), batch, jax.random.PRNGKey(1))
+    s_s, m_s = step_s(init_s(params), batch, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(m_p["loss"]), float(m_s["loss"]), rtol=1e-5)
+    assert float(m_s["loss_scale"]) == 1024.0 and int(m_s["skipped"]) == 0
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(s_p.params), jax.tree_util.tree_leaves(s_s.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-6)
+
+
+def test_loss_scale_dynamic_overflow_skips_and_halves():
+    """Dynamic scaling: a nonfinite gradient must skip the update entirely
+    (params/moments untouched) and halve the scale; a clean step then
+    applies normally at the reduced scale."""
+    def loss_fn(p, batch, key):
+        # second invocation produces a nonfinite loss (traced-safe: driven
+        # by batch content, not python state)
+        return jnp.sum(p["w"] ** 2) * batch["blow"]
+
+    params = {"w": jnp.ones((4, 4))}
+    init_fn, step_fn = make_train_step(
+        loss_fn, optax.sgd(1e-2), settings=StepSettings(loss_scale="dynamic")
+    )
+    state = init_fn(jax.tree_util.tree_map(np.asarray, params))
+    scale0 = float(state.opt_state[1]["loss_scale"])
+    assert scale0 == 2.0 ** 15
+
+    # overflow step: loss = inf
+    state, m = step_fn(state, {"blow": jnp.asarray(jnp.inf)}, jax.random.PRNGKey(0))
+    assert int(m["skipped"]) == 1
+    assert float(state.opt_state[1]["loss_scale"]) == scale0 / 2
+    np.testing.assert_array_equal(np.asarray(state.params["w"]), np.ones((4, 4)))
+
+    # clean step at the reduced scale applies
+    state, m = step_fn(state, {"blow": jnp.asarray(1.0)}, jax.random.PRNGKey(1))
+    assert int(m["skipped"]) == 0
+    assert float(state.opt_state[1]["loss_scale"]) == scale0 / 2
+    assert not np.allclose(np.asarray(state.params["w"]), np.ones((4, 4)))
+
+
+def test_loss_scale_with_grad_accum_and_bf16_storage():
+    """Loss scaling composes with microbatch accumulation and pure-bf16
+    param storage (the full fp16-parity recipe in one step)."""
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    batch = batch_for(cfg, b=8)
+    init_fn, step_fn = make_train_step(
+        dalle_loss(cfg), optax.adam(1e-3),
+        settings=StepSettings(grad_accum=2, loss_scale="dynamic",
+                              param_dtype=jnp.bfloat16),
+    )
+    state, m = step_fn(init_fn(params), batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"])) and int(m["skipped"]) == 0
+
+
+def test_bare_with_mesh_plain_mesh_still_discovered():
+    """A plain jax.sharding.Mesh entered with a bare `with mesh:` (no
+    make_mesh / mesh_context) must still be visible to active_mesh() — the
+    pre-round-5 user idiom for engaging the pipeline / ring attention."""
+    import numpy as _np
+    from jax.sharding import Mesh as PlainMesh
+
+    from dalle_pytorch_tpu.parallel.mesh import MESH_AXES, active_mesh
+
+    devs = _np.asarray(jax.devices()).reshape(2, 2, 1, 1, 2)
+    plain = PlainMesh(devs, MESH_AXES)
+    assert active_mesh() is None
+    with plain:
+        got = active_mesh()
+        assert got is not None and dict(got.shape) == dict(plain.shape)
+    assert active_mesh() is None
