@@ -156,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "requires --scan_layers and depth %% pp == 0)")
     parser.add_argument("--pp_num_micro", type=int, default=None,
                         help="pipeline microbatches (default: auto)")
+    parser.add_argument("--pp_interleave", type=int, default=1,
+                        help="circular pipeline: chunks per device (bubble time "
+                             "drops ~v-fold; needs depth %% (pp*v) == 0 and "
+                             "num_micro >= pp)")
     parser.add_argument("--flops_profiler", action="store_true",
                         help="capture a jax profiler trace around step 200 and stop at 201")
     return backend_mod.wrap_arg_parser(parser)
@@ -396,6 +400,7 @@ def main(argv=None):
         dalle_cfg,
         pipeline_axis="pp" if args.mesh_pp > 1 else None,
         pp_num_micro=args.pp_num_micro,
+        pp_interleave=args.pp_interleave,
     )
 
     from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch

@@ -62,6 +62,7 @@ class DALLEConfig:
     attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
     seq_shard_axis: Optional[str] = None  # sequence-parallel mesh axis (e.g. 'sp')
     pipeline_axis: Optional[str] = None  # pipeline-parallel mesh axis (e.g. 'pp')
+    pp_interleave: int = 1  # circular pipeline chunks per device (bubble / v)
     pp_num_micro: Optional[int] = None  # GPipe microbatches (None = auto)
 
     # -- derived ----------------------------------------------------------
@@ -116,6 +117,7 @@ class DALLEConfig:
             seq_shard_axis=self.seq_shard_axis,
             pipeline_axis=self.pipeline_axis,
             pp_num_micro=self.pp_num_micro,
+            pp_interleave=self.pp_interleave,
         )
 
     def to_dict(self) -> dict:

@@ -95,6 +95,10 @@ class TransformerConfig:
     pipeline_axis: Optional[str] = None
     # microbatches per pipeline step (None = largest of 2P / P dividing batch)
     pp_num_micro: Optional[int] = None
+    # circular/interleaved pipeline: each device holds pp_interleave chunks
+    # of depth/(pp*v) layers and microbatches loop the ring v times — bubble
+    # time drops ~v-fold (see parallel/pipeline.py).  Needs num_micro >= pp.
+    pp_interleave: int = 1
     conv_kernel_size: int = 5
     conv_dilation: int = 1
     sparse_block_size: int = 16
@@ -785,6 +789,7 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
                 # collectives inside the stage body; bubble stages must still
                 # execute them (see pipeline_scan docstring)
                 skip_bubble=cfg.seq_shard_axis is None,
+                interleave=cfg.pp_interleave,
             )
         import warnings
 

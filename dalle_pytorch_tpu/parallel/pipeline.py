@@ -10,12 +10,15 @@ natural TPU scale-out axis once tensor parallelism saturates a slice.  Design:
   stage holds depth/P contiguous layers and runs them with the same
   (rematted) per-layer body the single-chip path uses.
 - Forward schedule: M microbatches over P stages, T = M+P-1 ticks inside one
-  `lax.scan`; activations hop stages with a single `ppermute` per tick.
-  Bubble fraction (P-1)/T.
+  `lax.scan` (T = v*M+P-1 chunk-sized ticks under interleave=v); activations
+  hop stages with a single `ppermute` per tick.  Bubble fraction (P-1)/T of
+  the tick count — and ticks are v x shorter when interleaved.
 - Backward schedule: NOT autodiff through the tick scan.  `pipeline_scan` is
   a `jax.custom_vjp`: the forward saves ONLY each microbatch's stage-input
-  boundary activation (M boundary tensors per stage — megabytes at flagship
-  scale), and the backward runs the explicit reverse pipeline: the last
+  boundary activation (M boundary tensors per stage — v*M under
+  interleave=v, since every ring loop has its own boundary — megabytes at
+  flagship scale either way), and the backward runs the explicit reverse
+  pipeline: the last
   stage starts first, cotangents hop stages with the inverse ppermute, and
   each stage recomputes its forward from the saved boundary before applying
   the vjp (the 1F1B backward phase, expressed as its own tick scan).  This
@@ -92,6 +95,7 @@ def pipeline_scan(
     num_micro: Optional[int] = None,
     fold_micro: Optional[Callable] = None,  # (xs_local, micro_id) -> xs_local
     skip_bubble: bool = True,
+    interleave: int = 1,
 ) -> jnp.ndarray:
     """Drop-in replacement for `lax.scan(body, x, xs)[0]` over stacked layers,
     with the depth axis sharded over `axis` and the batch microbatched.
@@ -101,6 +105,15 @@ def pipeline_scan(
     index into dropout keys so microbatches don't share masks (a single-stage
     scan draws one mask for the whole batch; a pipeline processes microbatches
     separately and must not reuse the identical mask for each).
+
+    `interleave` (v): the circular/looped schedule — the depth splits into
+    v*P chunks and each device holds every P-th chunk, so a microbatch loops
+    the ring v times.  Ticks shrink to chunk-granularity: T = v*M + P - 1
+    ticks of depth/(v*P) layers each, vs GPipe's (M + P - 1) ticks of
+    depth/P layers — bubble time drops ~v-fold ((P-1) chunk-ticks instead of
+    (P-1) stage-ticks).  Wrap-around activations ride the same ppermute ring
+    into a per-microbatch holding buffer on stage 0 (and its mirror on the
+    last stage in the backward).  Requires num_micro >= P.
 
     `skip_bubble`: bubble ticks skip the stage compute entirely via lax.cond.
     This is only sound when the stage body contains no GLOBAL collectives:
@@ -113,12 +126,32 @@ def pipeline_scan(
     stages = mesh.shape[axis]
     depth = jax.tree_util.tree_leaves(xs)[0].shape[0]
     batch = x.shape[0]
-    assert depth % stages == 0, f"depth {depth} % pp {stages} != 0"
+    v = int(interleave)
+    assert v >= 1, f"interleave must be >= 1, got {interleave}"
+    assert depth % (stages * v) == 0, (
+        f"depth {depth} % (pp {stages} * interleave {v}) != 0"
+    )
     if num_micro is None:
         num_micro = default_num_micro(batch, stages)
     assert batch % num_micro == 0, f"batch {batch} % num_micro {num_micro} != 0"
     M = num_micro
-    ticks = M + stages - 1
+    if v > 1:
+        assert M >= stages, (
+            f"interleave needs num_micro ({M}) >= pp stages ({stages}): the "
+            "wrap-around buffer must be written before it is read"
+        )
+        # cyclic chunk assignment: device s holds chunks {s, s+P, ...} — a
+        # plain transpose on the stacked depth axis, differentiated through
+        # normally (it sits OUTSIDE the custom_vjp boundary)
+        cl = depth // (stages * v)
+        xs = jax.tree_util.tree_map(
+            lambda l: l.reshape(v, stages, cl, *l.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(depth, *l.shape[1:]),
+            xs,
+        )
+    VM = v * M
+    ticks = VM + stages - 1
     xm = x.reshape(M, batch // M, *x.shape[1:])
 
     # Split xs into differentiable (float) and non-differentiable (mask
@@ -140,8 +173,16 @@ def pipeline_scan(
                 ii += 1
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def stage_fn(fl_local, il_local, h, micro_id):
-        """All of this stage's layers on one microbatch's activations."""
+    def stage_fn(fl_local, il_local, h, micro_id, chunk=None):
+        """This stage's layers (one chunk of them under interleave) on one
+        microbatch's activations."""
+        if v > 1:
+            cl_ = jax.tree_util.tree_leaves(fl_local)[0].shape[0] // v
+            pick = lambda l: jax.lax.dynamic_index_in_dim(
+                l.reshape(v, cl_, *l.shape[1:]), chunk, 0, keepdims=False
+            )
+            fl_local = jax.tree_util.tree_map(pick, fl_local)
+            il_local = jax.tree_util.tree_map(pick, il_local)
         ws = rebuild(fl_local, il_local)
         if fold_micro is not None:
             ws = fold_micro(ws, micro_id)
@@ -156,36 +197,54 @@ def pipeline_scan(
         s = jax.lax.axis_index(axis)
 
         def tick(carry, t):
-            h, outs, saved = carry
-            x_in = jax.lax.dynamic_index_in_dim(
-                xm_in, jnp.clip(t, 0, M - 1), 0, keepdims=False
-            )
-            h = jnp.where(s == 0, x_in, h)  # first stage ingests microbatch t
-            m = t - s
-            valid = (m >= 0) & (m < M)
-            mc = jnp.clip(m, 0, M - 1)
+            h, outs, saved, ring = carry
+            if v > 1:
+                # the rotated-in h is the last stage's output of virtual
+                # micro t - P: stage 0 banks it for the next ring loop
+                # BEFORE ingestion overwrites h (write-then-read also makes
+                # the M == P same-tick handoff correct)
+                slot_w = (t - stages) % M
+                prev_r = jax.lax.dynamic_index_in_dim(ring, slot_w, 0, keepdims=False)
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, jnp.where((s == 0) & (t >= stages), h, prev_r), slot_w, 0
+                )
+                x_fresh = jax.lax.dynamic_index_in_dim(
+                    xm_in, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                )
+                x_wrap = jax.lax.dynamic_index_in_dim(ring, t % M, 0, keepdims=False)
+                x_in = jnp.where(t < M, x_fresh, x_wrap)
+            else:
+                x_in = jax.lax.dynamic_index_in_dim(
+                    xm_in, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                )
+            h = jnp.where(s == 0, x_in, h)  # first stage ingests
+            j = t - s  # virtual micro = (round, micro) flattened
+            valid = (j >= 0) & (j < VM)
+            jc = jnp.clip(j, 0, VM - 1)
+            mc = jc % M
+            chunk = jnp.clip(jc // M, 0, v - 1)
             if with_saved:
-                # the boundary activation entering this stage for microbatch
-                # mc — the ONLY tensor the backward keeps per microbatch
+                # the boundary activation entering this stage for virtual
+                # micro jc — the ONLY tensor the backward keeps per micro
                 saved = jax.lax.cond(
                     valid,
-                    lambda sv: jax.lax.dynamic_update_index_in_dim(sv, h, mc, 0),
+                    lambda sv: jax.lax.dynamic_update_index_in_dim(sv, h, jc, 0),
                     lambda sv: sv,
                     saved,
                 )
             if skip_bubble:
                 h = jax.lax.cond(
                     valid,
-                    lambda hh: stage_fn(fl_local, il_local, hh, mc),
+                    lambda hh: stage_fn(fl_local, il_local, hh, mc, chunk),
                     lambda hh: hh,
                     h,
                 )
             else:
                 # every device must reach the stage body's collectives on
                 # every tick; bubble output is discarded by the select
-                h = jnp.where(valid, stage_fn(fl_local, il_local, h, mc), h)
-            # last stage records each microbatch as it finishes
-            om = t - (stages - 1)
+                h = jnp.where(valid, stage_fn(fl_local, il_local, h, mc, chunk), h)
+            # last stage records each LAST-round microbatch as it finishes
+            om = t - (stages - 1) - (v - 1) * M
             oc = jnp.clip(om, 0, M - 1)
             write = (s == stages - 1) & (om >= 0)
             prev = jax.lax.dynamic_index_in_dim(outs, oc, 0, keepdims=False)
@@ -193,13 +252,19 @@ def pipeline_scan(
                 outs, jnp.where(write, h, prev), oc, 0
             )
             h = jax.lax.ppermute(h, axis, fwd_perm)
-            return (h, outs, saved), None
+            return (h, outs, saved, ring), None
 
         var = lambda z: jax.lax.pcast(z, (axis,), to="varying")
         h0 = var(jnp.zeros_like(xm_in[0]))
         outs0 = var(jnp.zeros_like(xm_in))
-        saved0 = var(jnp.zeros_like(xm_in)) if with_saved else h0  # dummy
-        (_, outs, saved), _ = jax.lax.scan(tick, (h0, outs0, saved0), jnp.arange(ticks))
+        ring0 = outs0 if v > 1 else h0  # dummy when not interleaved
+        saved0 = (
+            var(jnp.zeros((VM, *xm_in.shape[1:]), xm_in.dtype))
+            if with_saved else h0  # dummy
+        )
+        (_, outs, saved, _), _ = jax.lax.scan(
+            tick, (h0, outs0, saved0, ring0), jnp.arange(ticks)
+        )
         # only the last stage's buffer holds real outputs; psum-select makes
         # the result replicated over `axis` (out_specs P())
         out = jax.lax.psum(jnp.where(s == stages - 1, outs, jnp.zeros_like(outs)), axis)
@@ -228,28 +293,53 @@ def pipeline_scan(
         return fn(fl_, il_, xm_)
 
     def per_stage_bwd(fl_local, il_local, saved_local, g):
-        """Reverse pipeline: stage P-1 starts at tick 0, injects the loss
-        cotangent for its microbatch, recomputes its forward from the saved
-        boundary, applies the vjp, and sends the input-cotangent to the
-        previous stage via the inverse rotation."""
+        """Reverse pipeline: the last stage starts at tick 0 with the LAST
+        virtual micro, injects the loss cotangent (final round) or the
+        wrap-around cotangent banked from stage 0's rotations (earlier
+        rounds), recomputes its forward from the saved boundary, applies the
+        vjp, and sends the input-cotangent backwards via the inverse
+        rotation."""
         s = jax.lax.axis_index(axis)
         saved_local = saved_local[0]  # drop the (1,) stage-stacking dim
 
         def tick(carry, u):
-            dh, dfl, dx = carry
-            m = u - (stages - 1 - s)
-            valid = (m >= 0) & (m < M)
-            mc = jnp.clip(m, 0, M - 1)
-            # cotangent injection replaces whatever rotated in (mirrors the
-            # forward's stage-0 ingestion overwrite, which makes the rotated
+            dh, dfl, dx, dring = carry
+            # virtual micro handled this tick, in REVERSE order
+            j_lin = u - (stages - 1 - s)
+            valid = (j_lin >= 0) & (j_lin < VM)
+            jj = jnp.clip(VM - 1 - j_lin, 0, VM - 1)
+            mc = jj % M
+            chunk = jnp.clip(jj // M, 0, v - 1)
+            if v > 1:
+                # bank the rotated-in dh: it is stage 0's input-cotangent for
+                # virtual micro VM+P-1-u, i.e. the wrap cotangent the last
+                # stage will need for that micro minus one round (write
+                # before read — the M == P same-tick handoff again)
+                jj_src = VM + stages - 1 - u
+                slot_w = jj_src % M
+                prev_r = jax.lax.dynamic_index_in_dim(dring, slot_w, 0, keepdims=False)
+                dring = jax.lax.dynamic_update_index_in_dim(
+                    dring,
+                    jnp.where((s == stages - 1) & (u >= stages), dh, prev_r),
+                    slot_w, 0,
+                )
+                g_hi = jax.lax.dynamic_index_in_dim(
+                    g, jnp.clip(jj - (v - 1) * M, 0, M - 1), 0, keepdims=False
+                )
+                g_lo = jax.lax.dynamic_index_in_dim(dring, mc, 0, keepdims=False)
+                g_in = jnp.where(jj >= (v - 1) * M, g_hi, g_lo)
+            else:
+                g_in = jax.lax.dynamic_index_in_dim(g, mc, 0, keepdims=False)
+            # injection replaces whatever rotated in (mirrors the forward's
+            # stage-0 ingestion overwrite, which makes the rotated
             # wrap-around value's cotangent exactly zero)
-            g_in = jax.lax.dynamic_index_in_dim(g, mc, 0, keepdims=False)
             dh = jnp.where(s == stages - 1, g_in, dh)
 
             def do(dh_):
-                h_in = jax.lax.dynamic_index_in_dim(saved_local, mc, 0, keepdims=False)
+                h_in = jax.lax.dynamic_index_in_dim(saved_local, jj, 0, keepdims=False)
                 _, vjp_fn = jax.vjp(
-                    lambda fl_, hh: stage_fn(fl_, il_local, hh, mc), fl_local, h_in
+                    lambda fl_, hh: stage_fn(fl_, il_local, hh, mc, chunk),
+                    fl_local, h_in,
                 )
                 dfl_i, dh_in = vjp_fn(dh_)
                 return dfl_i, dh_in
@@ -268,15 +358,15 @@ def pipeline_scan(
                 )
                 dh = jnp.where(valid, dh_run, dh)
             dfl = jax.tree_util.tree_map(jnp.add, dfl, dfl_add)
-            # the cotangent leaving stage 0 is d x_in for microbatch mc
+            # the cotangent leaving stage 0 on the FIRST round is d x_in
             dx = jax.lax.cond(
-                valid & (s == 0),
+                valid & (s == 0) & (jj < M),
                 lambda d: jax.lax.dynamic_update_index_in_dim(d, dh, mc, 0),
                 lambda d: d,
                 dx,
             )
             dh = jax.lax.ppermute(dh, axis, bwd_perm)
-            return (dh, dfl, dx), None
+            return (dh, dfl, dx, dring), None
 
         var = lambda z: jax.lax.pcast(z, (axis,), to="varying")
         dh0 = var(jnp.zeros_like(g[0]))
@@ -284,7 +374,10 @@ def pipeline_scan(
         # zeros need no pcast (g is replicated, so its derivatives do)
         dfl0 = jax.tree_util.tree_map(jnp.zeros_like, fl_local)
         dx0 = var(jnp.zeros_like(g))
-        (_, dfl, dx), _ = jax.lax.scan(tick, (dh0, dfl0, dx0), jnp.arange(ticks))
+        dring0 = dx0 if v > 1 else dh0  # dummy when not interleaved
+        (_, dfl, dx, _), _ = jax.lax.scan(
+            tick, (dh0, dfl0, dx0, dring0), jnp.arange(ticks)
+        )
         dx = jax.lax.psum(jnp.where(s == 0, dx, jnp.zeros_like(dx)), axis)
         # dfl leaves are local (depth/P, ...) blocks — out_specs P(axis)
         # concatenates them straight back to the global (depth, ...) layout
