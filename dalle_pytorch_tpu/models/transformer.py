@@ -383,8 +383,11 @@ def _use_ring(cfg, pattern, key_mask) -> bool:
     return (
         cfg.attn_kernel == "ring"
         and cfg.seq_shard_axis is not None
-        and pattern is None  # ring path is for 'full' layers; patterned
-        and key_mask is None  # layers fall back to the GSPMD dense path
+        # 2-D static patterns ride the ring (each device holds its row/col
+        # mask blocks); per-head (3-D) patterns and padded-key masks fall
+        # back to the GSPMD dense path
+        and (pattern is None or getattr(pattern, "ndim", 2) == 2)
+        and key_mask is None
     )
 
 
@@ -419,6 +422,7 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
             out = ring_attention(
                 q, k, v, mesh, causal=cfg.causal,
                 axis_name=cfg.seq_shard_axis, scale=cfg.dim_head ** -0.5,
+                mask=None if pattern is None else jnp.asarray(pattern[:n, :n]),
             )
             out = linear(shared["out"], _merge_heads(out))
             return apply_dropout(dkey, out, cfg.attn_dropout)

@@ -39,8 +39,18 @@ def _causal_block_mask(s, my, src, n):
     return jnp.where(k_pos <= q_pos, s, _NEG)
 
 
-def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool, scale: float):
-    """Online-softmax ring.  Returns (out, lse) with lse: (b, h, n, 1)."""
+def _pattern_block(mask_rows, col_owner, nk):
+    """(n_rows_local, nk) sub-block of a row-sharded global pattern: the
+    columns owned by `col_owner` (traced)."""
+    return jax.lax.dynamic_slice(
+        mask_rows, (0, col_owner * nk), (mask_rows.shape[0], nk)
+    )
+
+
+def _ring_fwd_pass(q, k, v, mask_rows, axis_name: str, causal: bool, scale: float):
+    """Online-softmax ring.  Returns (out, lse) with lse: (b, h, n, 1).
+    mask_rows: optional (n_loc, n_glob) — this device's query rows of a
+    global static pattern (True = may attend)."""
     n_dev = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, n, d = q.shape
@@ -58,6 +68,8 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool, scale: float):
         s = jnp.einsum("bhid,bhjd->bhij", q32, k_cur.astype(jnp.float32))
         if causal:
             s = _causal_block_mask(s, my, src, n)
+        if mask_rows is not None:
+            s = jnp.where(_pattern_block(mask_rows, src, n), s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p_exp = jnp.exp(s - m_new)
@@ -74,17 +86,24 @@ def _ring_fwd_pass(q, k, v, axis_name: str, causal: bool, scale: float):
     return out.astype(q.dtype), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _ring_attention_local(q, k, v, mask_rows, mask_cols,
+                          axis_name: str, causal: bool, scale: float):
     """q, k, v: (b, h, n_loc, d) — the local sequence shard.  Runs the full
-    ring inside shard_map."""
-    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+    ring inside shard_map.  mask_rows/(cols): the global pattern sharded by
+    query rows (forward) and by key columns (backward — the packet carries
+    other devices' QUERIES past our keys, so we need our key-columns against
+    every query row)."""
+    out, _ = _ring_fwd_pass(q, k, v, mask_rows, axis_name, causal, scale)
     return out
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
-    return out, (q, k, v, out, lse)
+def _ring_vjp_fwd(q, k, v, mask_rows, mask_cols, axis_name, causal, scale):
+    out, lse = _ring_fwd_pass(q, k, v, mask_rows, axis_name, causal, scale)
+    # mask_rows' SHAPE rides the residuals so its float0 cotangent can be
+    # built correctly ((n_loc, n_glob) != mask_cols' (n_glob, n_loc))
+    rows_shape = None if mask_rows is None else mask_rows.shape
+    return out, (q, k, v, mask_cols, rows_shape, out, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, res, do):
@@ -92,7 +111,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     saved logsumexp (never materialized across steps), K/V never move — the
     (q, do, lse, delta, dq) packet rotates instead and is home after n_dev
     hops with its dq complete."""
-    q, k, v, out, lse = res
+    q, k, v, mask_cols, rows_shape, out, lse = res
     n_dev = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     n = q.shape[2]
@@ -119,6 +138,13 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         s = jnp.einsum("bhid,bhjd->bhij", q_cur * scale, k32)
         if causal:
             s = _causal_block_mask(s, owner, my, n)
+        if mask_cols is not None:
+            # mask_cols: (n_glob, n_loc) — our key columns; take the rows of
+            # the queries we currently hold (owner's block)
+            sub = jax.lax.dynamic_slice(
+                mask_cols, (owner * n, 0), (n, mask_cols.shape[1])
+            )
+            s = jnp.where(sub, s, _NEG)
         p = jnp.exp(s - lse_cur)  # masked entries: exp(_NEG - lse) == 0
         dp = jnp.einsum("bhid,bhjd->bhij", do_cur, v32)
         ds = p * (dp - delta_cur)
@@ -132,7 +158,14 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         )
 
     dq = packet[4]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # cotangents for the two (boolean) mask views are float0 zeros, each in
+    # its OWN local shape (row-sharded vs column-sharded views differ)
+    drows = None if rows_shape is None else jnp.zeros(rows_shape, jax.dtypes.float0)
+    dcols = None if mask_cols is None else jnp.zeros(
+        mask_cols.shape, jax.dtypes.float0
+    )
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            drows, dcols)
 
 
 _ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -146,18 +179,33 @@ def ring_attention(
     causal: bool = True,
     axis_name: str = AXIS_SP,
     scale: float | None = None,
+    mask: jnp.ndarray | None = None,
 ):
     """Global (b, h, n, d) attention with n sharded over `axis_name`.
 
     Equivalent to dense softmax attention (ops/attention.py) with a causal
-    mask; n must divide evenly by the axis size."""
+    mask; n must divide evenly by the axis size.  `mask`: optional static
+    (n, n) bool pattern (True = may attend) — axial/conv/block-sparse layers
+    keep the O(n/P)-memory ring under sequence parallelism instead of
+    falling back to dense GSPMD attention.  Each device holds only its
+    row-block (fwd) and column-block (bwd) of the pattern: O(n^2/P) bool."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, None, axis_name, None)
+    if mask is None:
+        fn = jax.shard_map(
+            partial(_ring_attention_local, mask_rows=None, mask_cols=None,
+                    axis_name=axis_name, causal=causal, scale=scale),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q, k, v)
+    mask = jnp.asarray(mask, bool)
     fn = jax.shard_map(
         partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(axis_name, None), P(None, axis_name)),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, mask, mask)
