@@ -471,7 +471,8 @@ def _feed_forward(shared, cfg, x, dkey):
     return linear(shared["w2"], h)
 
 
-def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask):
+def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask,
+                       live=None):
     """Length-n prefix attention that also fills the KV cache from offset 0.
     Mutates layer_cache['k'/'v'] (caller passes a fresh dict copy)."""
     b, n, _ = x.shape
@@ -480,13 +481,27 @@ def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask):
     if rotary is not None:
         qkv = apply_rotary(rotary[:n], qkv)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    q = q * (cfg.dim_head ** -0.5)
     layer_cache["k"] = jax.lax.dynamic_update_slice(
         layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, 0, 0)
     )
     layer_cache["v"] = jax.lax.dynamic_update_slice(
         layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, 0, 0)
     )
+    if _use_flash(cfg, n, key_mask):
+        # generation prefill on the kernel path: the dense fallback below
+        # materializes a (b, h, n, n) mask — O(n^2) HBM per prefill at
+        # sampling time, which the kernel's causal/pattern/key-mask inputs
+        # make unnecessary
+        from dalle_pytorch_tpu.kernels.flash_attention import flash_attention
+
+        pm = pattern[..., :n, :n] if pattern is not None else None
+        km = key_mask[:, :n] if key_mask is not None else None
+        out = flash_attention(
+            q, k, v, mask=pm, causal=True, scale=cfg.dim_head ** -0.5,
+            key_mask=km, live=live,
+        )
+        return linear(shared["out"], _merge_heads(out))
+    q = q * (cfg.dim_head ** -0.5)
     i_idx = jnp.arange(n)[:, None]
     j_idx = jnp.arange(n)[None, :]
     mask = j_idx <= i_idx
@@ -545,7 +560,10 @@ def _residual_branch(
             h = _attention_full(attn_params, cfg, h, pattern, rotary, key_mask, dkey, live=live)
         elif mode == "prefill":
             layer_cache = dict(layer_cache)
-            h = _attention_prefill(attn_params, cfg, layer_cache, h, pattern, rotary, key_mask)
+            h = _attention_prefill(
+                attn_params, cfg, layer_cache, h, pattern, rotary, key_mask,
+                live=live,
+            )
         else:
             layer_cache = dict(layer_cache)
             h, (layer_cache["k"], layer_cache["v"]) = _attention_cached(
@@ -925,25 +943,49 @@ def _run_cached_scan(params, cfg, specs, x, cache, mode, rotary, key_mask=None,
     """Scan-layers version of the cached paths: one lax.scan over stacked
     params + stacked cache entries, per-layer pattern selected by traced
     index.  Returns (out, stacked new layer caches)."""
+    import numpy as np
+
     _assert_scannable(cfg, specs)
     offset = cache["offset"]
     masks_np, midx = _stacked_masks(cfg, specs, cfg.seq_len)
     masks = jnp.asarray(masks_np)
     stacked = _stacked_bundles(params, specs)
 
+    lives = None
+    if mode == "prefill":
+        # the scan selects a TRACED mask per layer, which defeats the flash
+        # kernel's trace-time liveness derivation — build the stacked tables
+        # at the prefill length, exactly like _apply_scan does for training
+        from dalle_pytorch_tpu.kernels.flash_attention import (
+            DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, resolve_block,
+        )
+
+        n = x.shape[1]
+        try:
+            bq = resolve_block(n, DEFAULT_BLOCK_Q)
+            bk = resolve_block(n, DEFAULT_BLOCK_K)
+            lives = jnp.asarray(np.stack([
+                m[:n, :n].reshape(n // bq, bq, n // bk, bk)
+                .any(axis=(1, 3)).astype(np.int32)
+                for m in masks_np
+            ]))
+        except ValueError:  # no valid block: the flash path won't be taken
+            lives = None
+
     def body(h, xs):
         bundle, mi, lc = xs
         mask = jnp.take(masks, mi, axis=0)
+        live = jnp.take(lives, mi, axis=0, mode="clip") if lives is not None else None
         fa, lc = _residual_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "attn",
             mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
-            layer_cache=lc, offset=offset, text_mode=text_only,
+            layer_cache=lc, offset=offset, text_mode=text_only, live=live,
         )
         h = h + fa
         fb, lc = _residual_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "ff",
             mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
-            layer_cache=lc, offset=offset, text_mode=text_only,
+            layer_cache=lc, offset=offset, text_mode=text_only, live=live,
         )
         return h + fb, lc
 

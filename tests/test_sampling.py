@@ -268,3 +268,48 @@ def test_top_k_keeps_exactly_k_on_ties():
     out = np.asarray(top_k_filter(logits, thres=0.7))  # k = 3
     assert np.isfinite(out).sum() == 3
     assert out[0, 0] == 5.0  # the unambiguous max always survives
+
+
+def test_greedy_sampling_flash_prefill_matches_oracle():
+    """Prefill on the Pallas kernel path (attn_kernel='flash', prefill length
+    divisible by 128): cached greedy sampling must match the full-recompute
+    oracle — the flash prefill replaces a (b, h, n, n) dense mask at
+    generation time."""
+    cfg = tiny_cfg(
+        # prefill length is bos + text = 128 — exactly one flash block, so
+        # the kernel path engages even on CPU (attn_kernel='flash' forces it)
+        text_seq_len=127, image_fmap_size=4, num_image_tokens=32,
+        attn_kernel="flash", attn_types=("full", "axial_row"),
+    )
+    from dalle_pytorch_tpu.models.transformer import _use_flash
+
+    assert _use_flash(cfg.transformer_config(), 128, None), (
+        "test premise broken: flash prefill must engage at n=128"
+    )
+    params, text = setup(cfg)
+    want = greedy_oracle(params, cfg, text)
+    got = np.asarray(
+        sample_image_codes(
+            params, cfg, text, jax.random.PRNGKey(9), filter_thres=0.97, temperature=1e-6
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_greedy_sampling_flash_prefill_scan_layers_matches_oracle():
+    """scan_layers + flash prefill: the traced per-layer mask comes with a
+    stacked tile-liveness table (dead pattern tiles stay skipped in the
+    prefill kernel) and cached sampling still matches the oracle."""
+    cfg = tiny_cfg(
+        text_seq_len=127, image_fmap_size=4, num_image_tokens=32,
+        attn_kernel="flash", scan_layers=True,
+        attn_types=("full", "axial_row"),
+    )
+    params, text = setup(cfg)
+    want = greedy_oracle(params, cfg, text)
+    got = np.asarray(
+        sample_image_codes(
+            params, cfg, text, jax.random.PRNGKey(9), filter_thres=0.97, temperature=1e-6
+        )
+    )
+    np.testing.assert_array_equal(got, want)
