@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+MFU_TARGET = 0.45  # BASELINE.md:25 — the flagship 1.3B depth-64 bar
+
 PEAK_BF16_FLOPS = {
     # per-chip peak dense bf16 FLOP/s
     "v5e": 197e12,
@@ -347,6 +349,18 @@ def main():
             fb = run_flagship(1152, 8, "full", fbatch=4, param_dtype="float32")
             fb["fallback_from"] = flagship["error"][:120]
             flagship = fb
+        elif flagship.get("mfu", 0) < MFU_TARGET:
+            # under target: try the higher-remat-ceiling point the residency
+            # model says is borderline-feasible (flash_qkv_ff saves halve at
+            # microbatch 4 — DESIGN.md round-5 residency table); keep the
+            # better of the two
+            alt = run_flagship(1152, 8, "flash_qkv_ff", fbatch=4, param_dtype="bfloat16")
+            if "error" not in alt and alt.get("mfu", 0) > flagship.get("mfu", 0):
+                alt["beat"] = {"remat_policy": "flash_qkv", "batch": 8,
+                               "mfu": flagship.get("mfu")}
+                flagship = alt
+            else:
+                flagship["alt_flash_qkv_ff_b4"] = alt.get("error", alt.get("mfu"))
         # round-1/2 continuity row: the 1.70B dim-1280 stand-in
         flagship_1p7b = run_flagship(1280, 10, "flash", fbatch=4, param_dtype="bfloat16")
 
@@ -403,7 +417,7 @@ def main():
             "metric": "MFU (flagship 1.3B depth-64 DALL-E train step, seq=1280)",
             "value": flagship["mfu"],
             "unit": "MFU",
-            "vs_baseline": round(flagship["mfu"] / 0.45, 4),
+            "vs_baseline": round(flagship["mfu"] / MFU_TARGET, 4),
             **common,
         }
     elif on_tpu:
@@ -412,7 +426,7 @@ def main():
                       "flagship row errored, dim-2048 proxy headline)",
             "value": round(img_tok_per_sec, 1),
             "unit": "img-tokens/s/chip",
-            "vs_baseline": round(mfu / 0.45, 4),
+            "vs_baseline": round(mfu / MFU_TARGET, 4),
             **common,
         }
     else:
