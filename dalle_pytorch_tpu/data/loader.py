@@ -460,12 +460,15 @@ def iterate_tar_shards(
     overrides the remote opener (tests inject flaky transports)."""
     open_remote = fetcher or (lambda url: _open_remote(url, retries, timeout))
 
-    def sample_entry(shard, stem, members):
-        img_bytes = None
+    def pick_image(members):
+        """The winning image entry under the extension preference order."""
         for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
             if ext in members:
-                img_bytes = members[ext]
-                break
+                return members[ext]
+        return None
+
+    def sample_entry(shard, stem, members):
+        img_bytes = pick_image(members)
         if img_bytes is None or caption_key not in members:
             return None
         return f"{shard}:{stem}", members[caption_key], img_bytes
@@ -483,11 +486,7 @@ def iterate_tar_shards(
             stem, _, ext = member.name.rpartition(".")
             samples.setdefault(stem, {})[ext.lower()] = member
         for stem, members in samples.items():
-            img_member = None
-            for ext in (image_key, "jpg", "jpeg", "png", "bmp"):
-                if ext in members:
-                    img_member = members[ext]
-                    break
+            img_member = pick_image(members)
             if img_member is None or caption_key not in members:
                 continue
             try:
@@ -538,7 +537,7 @@ def iterate_tar_shards(
             entry = flush(stem_now, members)
             if entry is not None:
                 yield entry
-        if incomplete > max(complete, 0):
+        if incomplete > complete:
             handler(
                 RuntimeError(
                     f"{incomplete} of {incomplete + complete} sample groups had "
