@@ -850,3 +850,27 @@ def test_sequence_parallel_ring_with_patterned_cycle():
     _, m_m = step_m(state_m, batch, jax.random.PRNGKey(0))
 
     np.testing.assert_allclose(float(m_s["loss"]), float(m_m["loss"]), rtol=2e-4)
+
+
+def test_loss_scale_on_sharded_mesh():
+    """Dynamic loss scaling composes with ZeRO-3 mesh sharding: the scale
+    state rides beside the optimizer state through opt_state_specs and the
+    sharded step, and the trajectory still matches the unsharded run."""
+    cfg = tiny_cfg()
+    params = jax.tree_util.tree_map(
+        np.asarray, dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    )
+    batch = batch_for(cfg)
+    st = StepSettings(loss_scale="dynamic", zero_stage=3)
+
+    init_s, step_s = make_train_step(dalle_loss(cfg), optax.adam(1e-3),
+                                     settings=StepSettings(loss_scale="dynamic"))
+    _, m_s = step_s(init_s(params), batch, jax.random.PRNGKey(0))
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    init_m, step_m = make_train_step(dalle_loss(cfg), optax.adam(1e-3),
+                                     mesh=mesh, settings=st)
+    state = init_m(params)
+    state, m_m = step_m(state, batch, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(m_s["loss"]), float(m_m["loss"]), rtol=2e-4)
+    assert float(m_m["loss_scale"]) == 2.0 ** 15 and int(m_m["skipped"]) == 0
