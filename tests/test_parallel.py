@@ -434,9 +434,11 @@ def test_composed_dp_tp_pp_matches_single_device():
 
 def test_composed_fsdp_sp_pp_matches_single_device():
     """The other three-axis composition: ZeRO-3 param sharding (fsdp=2) ×
-    sequence parallelism (sp=2) × pipeline stages (pp=2) in one mesh."""
+    sequence parallelism (sp=2) × pipeline stages (pp=2) in one mesh —
+    with the interleaved schedule on top (bubble ticks must still execute
+    the seq-shard halo collectives on every device)."""
     cfg_s = _pp_cfg()
-    cfg_p = _pp_cfg(pipeline_axis="pp", seq_shard_axis="sp")
+    cfg_p = _pp_cfg(pipeline_axis="pp", seq_shard_axis="sp", pp_interleave=2)
     params = jax.tree_util.tree_map(
         np.asarray, dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg_s)
     )
