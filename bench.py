@@ -207,23 +207,64 @@ def main():
 
     n_matmul = matmul_param_count(state.params)
 
+    from dalle_pytorch_tpu.observability import (
+        CompileWatcher, SpanRecorder, step_cost_analysis,
+    )
+
+    watcher = CompileWatcher().start()
+
     # NB: timing must end with an actual device->host value fetch —
     # block_until_ready alone can return before remote execution finishes on
     # tunneled platforms, producing absurd numbers.
     for i in range(warmup):
         state, metrics = step_fn(state, batch_data, jax.random.PRNGKey(i))
     float(metrics["loss"])
+    watcher.arm()  # steady state: any compile in the measured loop is news
 
     t0 = time.perf_counter()
     for i in range(steps):
         state, metrics = step_fn(state, batch_data, jax.random.PRNGKey(100 + i))
     final_loss = float(metrics["loss"])  # forces the chained steps to completion
     dt = time.perf_counter() - t0
+    # snapshot NOW: the telemetry pass below (and a cost-analysis compile
+    # fallback) may fire further compile events that are not loop recompiles
+    loop_recompiles = watcher.recompiles
 
     step_time = dt / steps
     img_tok_per_sec = batch * cfg.image_seq_len / step_time
     flops = dalle_step_flops(cfg, batch, n_matmul)
     mfu = flops / step_time / _chip_peak()
+
+    # span breakdown beside the MFU number: a SEPARATE short synced pass
+    # (per-step blocking inside the timed loop would break the chained
+    # dispatch the throughput row measures), plus XLA's own FLOPs estimate
+    # vs the analytic model the MFU is priced with
+    rec = SpanRecorder(None)  # in-memory; summaries only
+    tele_steps = []
+    for i in range(2):
+        rec.start_step(i)
+        with rec.span("dispatch"):
+            state, metrics = step_fn(state, batch_data, jax.random.PRNGKey(200 + i))
+        with rec.span("block"):
+            float(metrics["loss"])
+        tele_steps.append(rec.end_step())
+    ca = step_cost_analysis(step_fn, state, batch_data, jax.random.PRNGKey(201))
+    compiled_flops = (ca or {}).get("flops")
+    watcher.stop()
+    telemetry_row = {
+        "dispatch_s": round(
+            sum(s["spans"].get("dispatch", 0.0) for s in tele_steps) / len(tele_steps), 5
+        ),
+        "block_s": round(
+            sum(s["spans"].get("block", 0.0) for s in tele_steps) / len(tele_steps), 5
+        ),
+        "compiles": watcher.compiles,
+        "recompiles_in_measured_loop": loop_recompiles,
+        "compile_time_s": round(watcher.compile_time_s, 2),
+        "flops_compiled_over_analytic": (
+            round(compiled_flops / flops, 4) if compiled_flops else None
+        ),
+    }
     params_million = round(
         sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1
     )
@@ -402,6 +443,7 @@ def main():
     }
     common = {
         "proxy_dim2048_depth8": proxy_row,
+        "telemetry": telemetry_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

@@ -43,6 +43,10 @@ def build_parser():
     parser.add_argument("--hug", action="store_true")
     parser.add_argument("--bpe_path", type=str, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--allow_legacy_pickle", action="store_true",
+                        help="permit loading pre-v3 (pickled-treedef) "
+                             "checkpoints — trusted sources only (legacy "
+                             "formats can execute code on load)")
     return parser
 
 
@@ -81,7 +85,9 @@ def main(argv=None):
         from dalle_pytorch_tpu.training.checkpoint import load_sharded
 
         restored, meta = load_sharded(str(path), only=("weights",))
-        vae_trees, vae_side_meta = load_checkpoint(str(path / "vae.npz"))
+        vae_trees, vae_side_meta = load_checkpoint(
+            str(path / "vae.npz"), allow_legacy_pickle=args.allow_legacy_pickle
+        )
         if meta.get("version") != __version__:
             print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
         dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
@@ -106,7 +112,9 @@ def main(argv=None):
         vae_cfg, vae_params = ref["vae_config"], ref["vae_params"]
         print(f"loaded reference-format checkpoint (version {ref.get('version')})")
     else:
-        trees, meta = load_checkpoint(str(path))
+        trees, meta = load_checkpoint(
+            str(path), allow_legacy_pickle=args.allow_legacy_pickle
+        )
         if meta.get("version") != __version__:
             print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
 

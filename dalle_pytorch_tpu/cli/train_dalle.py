@@ -26,6 +26,8 @@ from dalle_pytorch_tpu.data.loader import (
 )
 from dalle_pytorch_tpu.models import dalle as dalle_mod
 from dalle_pytorch_tpu.models import vae_registry
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
@@ -51,8 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
     group = parser.add_mutually_exclusive_group(required=False)
     group.add_argument("--vae_path", type=str, default=None, help="path to trained discrete VAE")
     group.add_argument("--dalle_path", type=str, default=None, help="path to partially-trained DALL-E to resume")
-    parser.add_argument("--image_text_folder", type=str, required=True,
-                        help="folder of image+text files, or a glob of .tar shards with --wds")
+    parser.add_argument("--image_text_folder", type=str, default=None,
+                        help="folder of image+text files, or a glob of .tar "
+                             "shards with --wds (required unless --dummy_run)")
     parser.add_argument("--taming", action="store_true",
                         help="use a pretrained taming VQGAN as the image tokenizer")
     parser.add_argument("--vqgan_model_path", type=str, default=None,
@@ -69,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--hug", action="store_true")
     parser.add_argument("--bpe_path", type=str, default=None)
     parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
+    parser.add_argument("--allow_legacy_pickle", action="store_true",
+                        help="permit loading pre-v3 (pickled-treedef) "
+                             "checkpoints via --vae_path/--dalle_path.  Only "
+                             "for files from trusted sources: legacy formats "
+                             "can execute code on load.  Re-saving migrates "
+                             "to the pickle-free v3 format")
     parser.add_argument("--bf16", action="store_true", help="bf16 compute (TPU-native mixed precision)")
     parser.add_argument("--fp16", action="store_true",
                         help="reference-compat fp16 mode: bf16 compute + DYNAMIC loss "
@@ -162,6 +171,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "num_micro >= pp)")
     parser.add_argument("--flops_profiler", action="store_true",
                         help="capture a jax profiler trace around step 200 and stop at 201")
+    # telemetry (observability/): on by default, JSONL-only — headless CPU
+    # runs keep full observability without any profiler infrastructure
+    parser.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                        help="telemetry output directory (spans JSONL, hang "
+                             "dumps).  Defaults to <output>.telemetry; "
+                             "'off' disables telemetry entirely")
+    parser.add_argument("--telemetry_heartbeat_s", type=float, default=900.0,
+                        help="hang-monitor deadline: if no step completes "
+                             "within this many seconds, dump thread stacks + "
+                             "last spans to the telemetry dir (0 disables)")
+    parser.add_argument("--telemetry_sync", type=int, default=1,
+                        help="1 (default): block on each step's result so "
+                             "per-step time splits into data_wait / dispatch "
+                             "/ block; 0: never block (dispatch-ahead "
+                             "preserved, block time reads as 0)")
+    parser.add_argument("--dummy_run", "--dummy-run", type=int, nargs="?",
+                        const=6, default=None, metavar="N",
+                        help="telemetry smoke mode: train N steps (default 6) "
+                             "of a tiny model on synthetic data — no dataset "
+                             "or VAE checkpoint needed; exercises the full "
+                             "telemetry path incl. a deliberate ragged final "
+                             "batch (recompile event)")
     return backend_mod.wrap_arg_parser(parser)
 
 
@@ -200,7 +231,9 @@ def reconstitute_vae(args, resume=None):
         if is_torch_checkpoint(args.vae_path):
             # a vae.pt trained with the torch reference — convert on load
             return load_reference_vae_checkpoint(args.vae_path)
-        trees, meta = load_checkpoint(args.vae_path)
+        trees, meta = load_checkpoint(
+            args.vae_path, allow_legacy_pickle=args.allow_legacy_pickle
+        )
         return trees["weights"], DiscreteVAEConfig(**meta["hparams"])
     if (args.vqgan_model_path or args.vqgan_config_path) and not args.taming:
         raise SystemExit(
@@ -289,10 +322,33 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
             rotate_checkpoints(str(path.parent), _rotation_glob(path), keep_n)
 
 
+def _apply_dummy_run_defaults(args):
+    """--dummy_run: shrink to a CPU-friendly synthetic smoke config that
+    still exercises every telemetry code path (spans, metrics, recompile
+    counting, FLOPs cross-check, report rendering)."""
+    args.dim, args.depth, args.heads, args.dim_head = 64, 2, 2, 16
+    args.text_seq_len, args.num_text_tokens = 16, 256
+    # 2x device count: the deliberately ragged final batch (half size) must
+    # still shard over the default dp mesh axis
+    import jax as _jax
+
+    args.batch_size = 2 * _jax.device_count()
+    args.epochs = 1
+    args.num_workers = min(args.num_workers, 2)
+    args.save_every_n_steps = 0
+    args.sample_every_n_steps = 0
+    args.log_every_n_steps = max(1, min(args.log_every_n_steps, 2))
+    return args
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
+    if args.dummy_run is not None:
+        args = _apply_dummy_run_defaults(args)
+    elif args.image_text_folder is None:
+        raise SystemExit("--image_text_folder is required (unless --dummy_run)")
 
     be = backend_mod.set_backend_from_args(args)
     be.initialize()
@@ -332,18 +388,32 @@ def main(argv=None):
         import json as _json
 
         sharded_resume = args.dalle_path
-        vae_trees, vae_side_meta = load_checkpoint(str(Path(args.dalle_path) / "vae.npz"))
+        vae_trees, vae_side_meta = load_checkpoint(
+            str(Path(args.dalle_path) / "vae.npz"),
+            allow_legacy_pickle=args.allow_legacy_pickle,
+        )
         meta = _json.loads((Path(args.dalle_path) / "meta.json").read_text())
         meta.update(vae_side_meta)
         resume = ({"vae_weights": vae_trees["vae_weights"]}, meta)
     else:
         resume = (
-            load_checkpoint(args.dalle_path)
+            load_checkpoint(args.dalle_path,
+                            allow_legacy_pickle=args.allow_legacy_pickle)
             if args.dalle_path is not None and ref_resume is None
             else None
         )
 
-    if ref_resume is not None:
+    if args.dummy_run is not None:
+        # tiny randomly-initialized image tokenizer: the smoke path must not
+        # depend on a trained VAE checkpoint or a pretrained download
+        from dalle_pytorch_tpu.models import vae as vae_mod
+
+        vae_cfg = DiscreteVAEConfig(
+            image_size=32, num_tokens=128, codebook_dim=32, num_layers=2,
+            num_resnet_blocks=0, hidden_dim=16,
+        )
+        vae_params = vae_mod.init_discrete_vae(jax.random.PRNGKey(args.seed), vae_cfg)
+    elif ref_resume is not None:
         vae_params, vae_cfg = ref_resume["vae_params"], ref_resume["vae_config"]
     else:
         vae_params, vae_cfg = reconstitute_vae(args, resume)
@@ -409,7 +479,25 @@ def main(argv=None):
 
     # data
     be.check_batch_size(args.batch_size)
-    if args.wds:
+    if args.dummy_run is not None:
+        def data_iter(epoch):
+            rs = np.random.RandomState(args.seed + epoch)
+            n = int(args.dummy_run)
+            for i in range(n):
+                # the final batch is deliberately ragged (half size): the
+                # telemetry smoke must observe a real recompile event
+                bs = args.batch_size
+                if i == n - 1 and n >= 2 and bs >= 2:
+                    bs //= 2
+                yield {
+                    "text": rs.randint(
+                        0, dalle_cfg.num_text_tokens,
+                        (bs, dalle_cfg.text_seq_len)).astype(np.int32),
+                    "image": rs.rand(
+                        bs, vae_cfg.image_size, vae_cfg.image_size, 3
+                    ).astype(np.float32),
+                }
+    elif args.wds:
         from dalle_pytorch_tpu.data.loader import expand_shard_spec, is_remote_shard
 
         if is_remote_shard(args.image_text_folder):
@@ -560,6 +648,20 @@ def main(argv=None):
         resume_run_id=(resume_meta or {}).get("wandb_run_id"),
     )
 
+    # telemetry: on by default (JSONL-only — no profiler infrastructure
+    # needed); --telemetry DIR redirects it, --telemetry off disables
+    tele = None
+    if args.telemetry != "off":
+        tele_dir = args.telemetry or f"{args.dalle_output_file_name}.telemetry"
+        tele = telemetry.configure(
+            dir=tele_dir, run_name=Path(args.dalle_output_file_name).name,
+            heartbeat_s=args.telemetry_heartbeat_s or None,
+            process_index=be.get_rank(),
+        )
+        if is_root:
+            print(f"[telemetry] spans + metrics + hang dumps -> {tele_dir} "
+                  f"(render with tools/telemetry_report.py)")
+
     out_file = f"{args.dalle_output_file_name}.pt"
     start_epoch = (resume_meta or {}).get("epoch", 0)
     # restoring the step counter keeps save/sample cadences and checkpoint
@@ -571,10 +673,14 @@ def main(argv=None):
         # `step` is the NEXT step to run after resume; mid-loop callers pass
         # global_step + 1 (the increment happens at loop end)
         fn = save_model_sharded if args.sharded_checkpoint else save_model
-        fn(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
-           keep_n=keep_n,
-           global_step=global_step if step is None else step,
-           wandb_run_id=logger.run_id)
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint", path=str(path)):
+            fn(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+               keep_n=keep_n,
+               global_step=global_step if step is None else step,
+               wandb_run_id=logger.run_id)
+        obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
+        obs_metrics.counter("checkpoints_saved").inc()
 
     # orbax saves are collective (every host writes its shards), so they run
     # on all processes; the npz path writes from the root host only
@@ -586,6 +692,8 @@ def main(argv=None):
 
     key = jax.random.PRNGKey(args.seed + 1)
     first_window = True
+    flops_checked = False
+    checked_recompiles = 0
     for epoch in range(start_epoch, args.epochs):
         t_window = time.time()
         window_start = global_step  # reset with t_window: a stale window
@@ -597,34 +705,102 @@ def main(argv=None):
             # running step (the reference's DataLoader workers + async .cuda())
             batches = prefetch_to_device(batches, size=args.prefetch_batches)
         epoch_batches = 0
-        for device_batch in batches:
+        batch_it = iter(batches)
+        while True:
+            if tele is not None:
+                tele.begin_step(global_step)
+            with telemetry.span("data_wait"):
+                device_batch = next(batch_it, None)
+            if device_batch is None:
+                if tele is not None:
+                    tele.abort_step()  # the wait that found the epoch's end
+                break
             epoch_batches += 1
             key, sk = jax.random.split(key)
             device_batch = {
                 "text": jnp.asarray(device_batch["text"]),
                 "image": jnp.asarray(device_batch["image"]),
             }
-            state, metrics = step_fn(state, device_batch, sk)
+            recompiles_now = (
+                tele.compile_watcher.recompiles
+                if tele is not None and tele.compile_watcher is not None else 0
+            )
+            if tele is not None and (not flops_checked
+                                     or recompiles_now > checked_recompiles):
+                # XLA-vs-analytic FLOPs cross-check: one extra trace (no
+                # second backend compile), shapes taken from the real batch.
+                # Re-checked after every detected recompile — consecutive
+                # divergent checks are what arm the persistent-divergence
+                # alarm (a one-off ragged-batch lowering is not)
+                flops_checked = True
+                checked_recompiles = recompiles_now
+                with telemetry.span("flops_crosscheck"):
+                    from dalle_pytorch_tpu.training.profiling import (
+                        dalle_step_flops, matmul_param_count,
+                    )
+
+                    analytic = dalle_step_flops(
+                        dalle_cfg, int(device_batch["text"].shape[0]),
+                        matmul_param_count(state.params),
+                    )
+                    ratio = tele.crosscheck_flops(
+                        step_fn, (state, device_batch, sk), analytic
+                    )
+                    if tele.compile_watcher is not None:
+                        # re-snapshot: anything the crosscheck itself fired
+                        # must not re-trigger it next step
+                        checked_recompiles = tele.compile_watcher.recompiles
+                    if is_root and ratio is not None:
+                        print(f"[telemetry] compiled/analytic FLOPs ratio: "
+                              f"{ratio:.3f}")
+            with telemetry.span("dispatch"):
+                state, metrics = step_fn(state, device_batch, sk)
+            if args.telemetry_sync and tele is not None:
+                # wait for THIS step's result: per-step wall-clock splits
+                # into data_wait / dispatch / block, the attribution the
+                # telemetry report renders.  --telemetry_sync 0 (or
+                # --telemetry off) restores unbounded dispatch-ahead
+                # (block reads as 0)
+                with telemetry.span("block"):
+                    jax.block_until_ready(metrics["loss"])
+            if tele is not None and "skipped" in metrics:
+                # exact per-step skip accounting.  int() waits for the step's
+                # result; with --telemetry_sync that wait already happened,
+                # without it this is the one forced sync per step the
+                # fp16-parity mode pays for correct skip counts
+                obs_metrics.counter("loss_scale_skips").inc(
+                    int(metrics["skipped"])
+                )
+                obs_metrics.gauge("loss_scale").set(float(metrics["loss_scale"]))
+            obs_metrics.counter("train_steps").inc()
 
             if global_step % args.log_every_n_steps == 0:
-                dt = time.time() - t_window
-                steps_done = global_step - window_start + 1
-                record = {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch}
-                if not first_window:
-                    # the process's first window spans jit compilation —
-                    # minutes for billion-parameter configs — so its rate
-                    # is not a throughput measurement
-                    record["sample_per_sec"] = args.batch_size * steps_done / max(dt, 1e-9)
-                first_window = False
-                t_window = time.time()
-                window_start = global_step + 1
-                logger.log(record, step=global_step)
+                with telemetry.span("log"):
+                    dt = time.time() - t_window
+                    steps_done = global_step - window_start + 1
+                    record = {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch}
+                    if not first_window:
+                        # the process's first window spans jit compilation —
+                        # minutes for billion-parameter configs — so its rate
+                        # is not a throughput measurement
+                        record["sample_per_sec"] = args.batch_size * steps_done / max(dt, 1e-9)
+                        obs_metrics.gauge("tokens_per_sec").set(
+                            args.batch_size * dalle_cfg.total_seq_len
+                            * steps_done / max(dt, 1e-9)
+                        )
+                    first_window = False
+                    t_window = time.time()
+                    window_start = global_step + 1
+                    logger.log(record, step=global_step)
+                    if tele is not None:
+                        tele.flush(logger, step=global_step)
             if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and save_here:
                 step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
                 save(step_file, epoch, keep_n=args.keep_n_checkpoints,
                      step=global_step + 1)
             if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
-                _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
+                with telemetry.span("sample"):
+                    _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
             if args.flops_profiler:
                 if global_step == 199:
                     jax.profiler.start_trace("./profile_trace")
@@ -632,7 +808,11 @@ def main(argv=None):
                     jax.profiler.stop_trace()
                     print("profiler trace written to ./profile_trace; stopping (parity with --flops_profiler)")
                     logger.finish()
+                    if tele is not None:
+                        tele.close()
                     return state, dalle_cfg
+            if tele is not None:
+                tele.finish_step(global_step)
             global_step += 1
 
         if epoch_batches == 0:
@@ -657,6 +837,11 @@ def main(argv=None):
         save(out_file, args.epochs)
         if is_root:
             logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
+    if tele is not None:
+        tele.flush(logger, step=global_step)
+        if is_root:
+            print(f"[telemetry] run summary: {tele.summary()}")
+        tele.close()
     logger.finish()
     return state, dalle_cfg
 

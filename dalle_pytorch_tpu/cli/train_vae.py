@@ -15,6 +15,8 @@ import optax
 
 from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches, prefetch_to_device
 from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
 from dalle_pytorch_tpu.training.checkpoint import save_checkpoint, to_host
@@ -53,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--wandb", action="store_true", help="log to Weights & Biases")
     parser.add_argument("--wandb_name", type=str, default="dalle_train_vae")
+    parser.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                        help="telemetry output directory (spans JSONL, hang "
+                             "dumps).  Defaults to <output>.telemetry; "
+                             "'off' disables telemetry entirely")
+    parser.add_argument("--telemetry_heartbeat_s", type=float, default=900.0,
+                        help="hang-monitor deadline in seconds (0 disables)")
+    parser.add_argument("--telemetry_sync", type=int, default=1,
+                        help="1 (default): block on each step's result so "
+                             "per-step time splits into data_wait / dispatch "
+                             "/ block; 0: never block")
     return backend_mod.wrap_arg_parser(parser)
 
 
@@ -103,6 +115,17 @@ def main(argv=None):
         wandb_kwargs={"name": args.wandb_name}, config=cfg.to_dict(), is_root=is_root,
     )
 
+    tele = None
+    if args.telemetry != "off":
+        from pathlib import Path as _Path
+
+        tele = telemetry.configure(
+            dir=args.telemetry or f"{args.vae_output_file_name}.telemetry",
+            run_name=_Path(args.vae_output_file_name).name,
+            heartbeat_s=args.telemetry_heartbeat_s or None,
+            process_index=be.get_rank(),
+        )
+
     @jax.jit
     def train_step(params, opt_state, images, key, temp, lr):
         def loss_fn(p):
@@ -145,11 +168,25 @@ def main(argv=None):
         )
         if args.prefetch_batches > 0:
             batches = prefetch_to_device(batches, size=args.prefetch_batches)
-        for images in batches:
+        batch_it = iter(batches)
+        while True:
+            if tele is not None:
+                tele.begin_step(global_step)
+            with telemetry.span("data_wait"):
+                images = next(batch_it, None)
+            if images is None:
+                if tele is not None:
+                    tele.abort_step()
+                break
             key, sk = jax.random.split(key)
-            params, opt_state, loss = train_step(
-                params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr)
-            )
+            with telemetry.span("dispatch"):
+                params, opt_state, loss = train_step(
+                    params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr)
+                )
+            if tele is not None and args.telemetry_sync:
+                with telemetry.span("block"):
+                    jax.block_until_ready(loss)
+            obs_metrics.counter("train_steps").inc()
 
             if global_step % 100 == 0:
                 # temperature annealing (reference train_vae.py:276-278)
@@ -161,6 +198,8 @@ def main(argv=None):
                      "codebook_used": used, "epoch": epoch},
                     step=global_step,
                 )
+                if tele is not None:
+                    tele.flush(logger, step=global_step)
                 if is_root:
                     # recon grids + hard recons + codebook histogram
                     # (reference train_vae.py:252-271)
@@ -177,7 +216,14 @@ def main(argv=None):
                     )
                     logger.log_histogram("codebook_indices", idx, step=global_step)
             if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
-                save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+                t0 = time.perf_counter()
+                with telemetry.span("checkpoint"):
+                    save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+                obs_metrics.histogram("checkpoint_save_s").observe(
+                    time.perf_counter() - t0
+                )
+            if tele is not None:
+                tele.finish_step(global_step)
             global_step += 1
 
         lr *= args.lr_decay_rate
@@ -185,6 +231,9 @@ def main(argv=None):
             save_model(f"{args.vae_output_file_name}.pt", params, cfg)
             logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
 
+    if tele is not None:
+        tele.flush(logger, step=global_step)
+        tele.close()
     logger.finish()
     return params, cfg
 

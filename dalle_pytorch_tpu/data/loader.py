@@ -20,11 +20,17 @@ import queue
 import random
 import tarfile
 import threading
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from dalle_pytorch_tpu.observability import counter as _counter
+from dalle_pytorch_tpu.observability import gauge as _gauge
+from dalle_pytorch_tpu.observability import histogram as _histogram
+from dalle_pytorch_tpu.observability import span as _span
 
 try:
     from PIL import Image, UnidentifiedImageError
@@ -199,7 +205,8 @@ def iterate_batches(
         e = epoch  # bind for the closure
 
         def load(j):
-            return dataset.get(int(j), _item_rng(seed, e, int(j)))
+            with _span("decode", aggregate=True):
+                return dataset.get(int(j), _item_rng(seed, e, int(j)))
 
         items = _parallel_map_ordered(
             load, order, num_workers, lookahead=2 * batch_size
@@ -248,8 +255,15 @@ def prefetch_to_device(batches: Iterable[dict], size: int = 2) -> Iterator:
     def producer():
         try:
             for b in batches:
-                if not _put(jax.tree_util.tree_map(jax.device_put, b)):
+                nbytes = sum(
+                    getattr(x, "nbytes", 0) for x in jax.tree_util.tree_leaves(b)
+                )
+                with _span("h2d_transfer", aggregate=True):
+                    device_b = jax.tree_util.tree_map(jax.device_put, b)
+                _counter("host_to_device_bytes").inc(nbytes)
+                if not _put(device_b):
                     return
+                _gauge("data_queue_depth").set(q.qsize())
             _put(sentinel)
         except BaseException as e:  # propagate into the consumer
             _put(e)
@@ -259,6 +273,9 @@ def prefetch_to_device(batches: Iterable[dict], size: int = 2) -> Iterator:
     try:
         while True:
             item = q.get()
+            # depth as the CONSUMER sees it: 0 here means the step loop is
+            # about to stall on data — the data-starvation signal
+            _gauge("data_queue_depth").set(q.qsize())
             if item is sentinel:
                 return
             if isinstance(item, BaseException):
@@ -364,6 +381,7 @@ def _open_remote(url: str, retries: int, timeout: float):
     /root/reference/train_dalle.py:218).  Raises on final failure — the
     caller's handler absorbs it (warn-and-continue)."""
     if url.startswith(("http://", "https://")):
+        import urllib.error
         import urllib.request
 
         last: Optional[Exception] = None
@@ -373,7 +391,16 @@ def _open_remote(url: str, retries: int, timeout: float):
                 return urllib.request.urlopen(
                     urllib.request.Request(url), timeout=timeout
                 )
-            except Exception as e:  # noqa: BLE001 — retry any transport error
+            except Exception as e:  # noqa: BLE001 — retry most transport errors
+                # EXCEPT permanent 4xx: the server is saying the REQUEST is
+                # wrong (404 from a typo'd shard prefix, 403 from missing
+                # auth) — retrying cannot succeed and turns a fail-fast into
+                # minutes of backoff per shard.  408 (request timeout) and
+                # 429 (rate limit) are the transient 4xx exceptions; 5xx is
+                # server-side and retried like any transport error.
+                if (isinstance(e, urllib.error.HTTPError)
+                        and 400 <= e.code < 500 and e.code not in (408, 429)):
+                    raise
                 last = e
                 if attempt < attempts - 1:  # no pointless backoff after the last try
                     import time
@@ -552,15 +579,22 @@ def iterate_tar_shards(
         counter = 0
         for shard in list(shards)[process_index::process_count]:
             try:
-                if is_remote_shard(shard):
-                    stream = open_remote(shard)
-                    tf = tarfile.open(fileobj=stream, mode="r|*")
-                    entries = stream_entries(tf, shard)
-                else:
-                    stream = None
-                    tf = tarfile.open(shard)
-                    entries = local_entries(tf, shard)
+                # aggregate: shard opens run on the loader thread CONCURRENTLY
+                # with the step loop — a top-level span here would add their
+                # wall-clock to the per-step attribution and push the split
+                # past 100%
+                with _span("shard_open", aggregate=True):
+                    if is_remote_shard(shard):
+                        stream = open_remote(shard)
+                        tf = tarfile.open(fileobj=stream, mode="r|*")
+                        entries = stream_entries(tf, shard)
+                    else:
+                        stream = None
+                        tf = tarfile.open(shard)
+                        entries = local_entries(tf, shard)
+                _counter("data_shards_opened").inc()
             except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+                _counter("data_shards_failed").inc()
                 handler(e, shard)
                 continue
             try:
@@ -577,18 +611,23 @@ def iterate_tar_shards(
 
     def decode(entry):
         name, caption_bytes, img_bytes, idx = entry
+        t0 = _time.perf_counter()
         try:
-            caption = caption_bytes.decode("utf-8").strip()
-            if not caption:
-                return None
-            rng = _item_rng(seed, 0, idx)
-            img = Image.open(io.BytesIO(img_bytes))
-            img = random_resized_crop(img.convert("RGB"), image_size, rng)
-            tokens = tokenizer.tokenize(caption, text_len, truncate_text=truncate_captions)[0]
-            return tokens, _image_to_array(img, "RGB")
+            with _span("decode", aggregate=True):
+                caption = caption_bytes.decode("utf-8").strip()
+                if not caption:
+                    return None
+                rng = _item_rng(seed, 0, idx)
+                img = Image.open(io.BytesIO(img_bytes))
+                img = random_resized_crop(img.convert("RGB"), image_size, rng)
+                tokens = tokenizer.tokenize(caption, text_len, truncate_text=truncate_captions)[0]
+                return tokens, _image_to_array(img, "RGB")
         except Exception as e:  # noqa: BLE001 — warn_and_continue parity
+            _counter("data_samples_failed").inc()
             handler(e, name)
             return None
+        finally:
+            _histogram("decode_s").observe(_time.perf_counter() - t0)
 
     for item in _parallel_map_ordered(decode, raw_entries(), num_workers, lookahead=64):
         if item is not None:

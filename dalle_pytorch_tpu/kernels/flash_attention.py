@@ -168,9 +168,11 @@ def _kmask_spec_arg(use_kmask, kmask, h, block_k, kv_grid=False):
     return [pl.BlockSpec(memory_space=pltpu.SMEM)], (jnp.zeros((1,), jnp.int32),)
 
 
+@jax.named_scope("flash_attn_fwd")
 def _flash_fwd(q, k, v, mask, live, kmask, h, causal, scale, block_q, block_k):
     """q, k, v: (bh, n, d); kmask: optional (b, n) int32 key-padding rows.
-    Returns (out (bh, n, d), lse (bh, n, LANES))."""
+    Returns (out (bh, n, d), lse (bh, n, LANES)).  The named scope makes the
+    kernel a labelled row in xprof traces (telemetry span mirroring)."""
     bh, n, d = q.shape
     assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
     nq, nk = n // block_q, n // block_k
@@ -301,6 +303,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, live_
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+@jax.named_scope("flash_attn_bwd")
 def _flash_bwd(q, k, v, do, out, lse, mask, live, kmask, h, causal, scale, block_q, block_k):
     bh, n, d = q.shape
     nq, nk = n // block_q, n // block_k
@@ -377,6 +380,7 @@ def _flash_bwd(q, k, v, do, out, lse, mask, live, kmask, h, causal, scale, block
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
 
+@jax.named_scope("flash_attn_bwd_xla")
 def _dense_recompute_grads(q, k, v, mask, kmask, h, causal, scale, lse, do):
     """Backward in XLA ops with exact probabilities from the saved logsumexp.
     Materializes (bh, n, n) transients (fused/streamed by XLA).  At 128x128

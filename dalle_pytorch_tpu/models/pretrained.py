@@ -109,12 +109,28 @@ def _convert_once(converted: Path, backend, convert_fn):
     from dalle_pytorch_tpu.training.checkpoint import load_checkpoint, save_checkpoint
 
     is_root = backend is None or backend.is_local_root_worker()
+    if is_root and converted.is_file() and _is_legacy_cache(converted):
+        # a conversion CACHE in a pre-v3 (pickled) format: regenerate rather
+        # than unpickle — the source weights are still on disk
+        converted.unlink()
     if is_root and not converted.is_file():
         trees, meta = convert_fn()
         save_checkpoint(str(converted), trees=trees, meta=meta)
     if backend is not None:
         backend.local_barrier()
     return load_checkpoint(str(converted))
+
+
+def _is_legacy_cache(path: Path) -> bool:
+    """True iff `path` is a checkpoint in a pre-v3 (pickled-treedef) format."""
+    import numpy as np
+
+    try:
+        with np.load(str(path), allow_pickle=False) as data:
+            return ("__format" not in data.files
+                    or int(data["__format"]) < 3)
+    except Exception:
+        return True  # unreadable cache: regenerate it too
 
 
 def load_openai_vae_pretrained(
@@ -131,7 +147,7 @@ def load_openai_vae_pretrained(
     backend = backend if backend is not None else _current_backend()
     converted = root / "openai_vae_converted.npz"
 
-    if converted.is_file() and backend is None:
+    if converted.is_file() and backend is None and not _is_legacy_cache(converted):
         from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
 
         trees, _ = load_checkpoint(str(converted))

@@ -1,0 +1,53 @@
+"""Unified telemetry: structured spans, a process-wide metrics registry,
+XLA-level introspection (recompile counting, memory peaks, FLOPs
+cross-checks), and a heartbeat/hang monitor.
+
+Instrumented code imports the cheap module-level helpers:
+
+    from dalle_pytorch_tpu.observability import span, counter, gauge, histogram
+
+which are no-ops / registry updates until a CLI calls
+`telemetry.configure(dir=...)`.  See tools/telemetry_report.py for turning a
+run's spans JSONL into a per-step time-attribution table."""
+from dalle_pytorch_tpu.observability.heartbeat import Heartbeat, thread_stacks
+from dalle_pytorch_tpu.observability.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+)
+from dalle_pytorch_tpu.observability.spans import SpanRecorder
+from dalle_pytorch_tpu.observability.telemetry import (
+    Telemetry,
+    active,
+    configure,
+    span,
+)
+from dalle_pytorch_tpu.observability.xla import (
+    CompileWatcher,
+    FlopsCrosscheck,
+    device_memory_stats,
+    record_memory_gauges,
+    step_cost_analysis,
+)
+
+__all__ = [
+    "REGISTRY",
+    "CompileWatcher",
+    "FlopsCrosscheck",
+    "Heartbeat",
+    "MetricsRegistry",
+    "SpanRecorder",
+    "Telemetry",
+    "active",
+    "configure",
+    "counter",
+    "device_memory_stats",
+    "gauge",
+    "histogram",
+    "record_memory_gauges",
+    "span",
+    "step_cost_analysis",
+    "thread_stacks",
+]

@@ -1,0 +1,191 @@
+"""Process-wide runtime metrics registry.
+
+Counters (monotonic: steps, loss-scale skips, host→device bytes), gauges
+(point-in-time: data-queue depth, tokens/sec, device memory peak) and
+histograms (distributions: checkpoint save latency, per-sample decode time).
+Instrumented code calls the module-level `counter()/gauge()/histogram()`
+helpers — no plumbing through call stacks — and the training loop flushes a
+snapshot through the existing `MetricLogger` JSONL sink (and/or the
+telemetry directory) at its logging cadence.
+
+Thread-safe; the data-loader worker threads and the prefetch producer update
+the same registry the step loop flushes.  All operations are a dict lookup +
+float add under a lock — cheap enough for per-sample instrumentation.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Optional
+
+
+class Counter:
+    """Monotonic counter.  `.inc(n)`; snapshot reports the running total and
+    the delta since the previous flush (rates without external bookkeeping)."""
+
+    __slots__ = ("name", "_value", "_last_flush", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._value = 0.0
+        self._last_flush = 0.0
+        self._lock = lock
+
+    def inc(self, n: float = 1.0):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _snapshot(self, reset_window: bool) -> Dict[str, float]:
+        delta = self._value - self._last_flush
+        if reset_window:
+            self._last_flush = self._value
+        return {"total": self._value, "delta": delta}
+
+
+class Gauge:
+    """Point-in-time value; snapshot reports last + the window max (peaks
+    like queue depth survive a coarse flush cadence)."""
+
+    __slots__ = ("name", "_value", "_max", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._value = None
+        self._max = None
+        self._lock = lock
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+            if self._max is None or v > self._max:
+                self._max = float(v)
+
+    @property
+    def value(self):
+        return self._value
+
+    def _snapshot(self, reset_window: bool) -> Dict[str, Any]:
+        out = {"last": self._value, "max": self._max}
+        if reset_window:
+            self._max = self._value
+        return out
+
+
+class Histogram:
+    """Streaming distribution: count/total/min/max plus log2-bucket counts
+    (bucket i holds values in [2^(i-1), 2^i) seconds/units) — enough for a
+    latency report without storing samples."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_buckets", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._buckets: Dict[int, int] = {}
+        self._lock = lock
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            b = -1074 if v <= 0 else int(math.ceil(math.log2(v)))
+            self._buckets[b] = self._buckets.get(b, 0) + 1
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    def _snapshot(self, reset_window: bool) -> Dict[str, Any]:
+        out = {"count": self.count, "total": self.total, "mean": self.mean,
+               "min": self.min, "max": self.max,
+               "log2_buckets": {str(k): v for k, v in sorted(self._buckets.items())}}
+        return out
+
+
+class MetricsRegistry:
+    """Create-or-get named instruments.  A name is bound to one instrument
+    kind for the life of the process; asking for the same name with a
+    different kind raises (silent shadowing hides bugs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, self._lock)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, requested {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self, reset_window: bool = True) -> Dict[str, Dict[str, Any]]:
+        """{name: {kind, ...stats}} for every registered instrument.
+
+        Runs under the shared instrument lock: `_snapshot` does unlocked
+        read-modify-writes (window delta/max resets), and an `inc()` landing
+        between its two reads would otherwise vanish from every window."""
+        out = {}
+        with self._lock:
+            for name, inst in self._instruments.items():
+                rec = inst._snapshot(reset_window)
+                rec["kind"] = type(inst).__name__.lower()
+                out[name] = rec
+        return out
+
+    def flush_to(self, logger, step: Optional[int] = None,
+                 reset_window: bool = True) -> Dict[str, Any]:
+        """Push a snapshot through a `MetricLogger` (JSONL + wandb when
+        active) as one quiet record under the 'telemetry' key."""
+        snap = self.snapshot(reset_window=reset_window)
+        if logger is not None and snap:
+            logger.log({"telemetry": snap}, step=step, quiet=True)
+        return snap
+
+    def reset(self):
+        """Drop every instrument (tests only — production metrics are
+        process-lifetime)."""
+        with self._lock:
+            self._instruments.clear()
+
+
+# process-wide default registry: instrumented code uses these module-level
+# helpers; the telemetry flusher reads the same object
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)

@@ -1,0 +1,203 @@
+"""Telemetry facade: one object wiring spans + metrics + XLA introspection +
+heartbeat, and the module-level `span()` the instrumented code calls.
+
+Lifecycle (what the CLIs do):
+
+    tele = telemetry.configure(dir=args.telemetry, run_name=...)
+    tele.crosscheck_flops(step_fn, (state, batch, key), analytic_flops)
+    for step:
+        with tele.step(i):
+            with telemetry.span("data_wait"): batch = next(it)
+            with telemetry.span("dispatch"): state, m = step_fn(...)
+            with telemetry.span("block"):    jax.block_until_ready(m["loss"])
+        # tele.step() exit stamps the heartbeat + flushes the step record
+    tele.flush(logger, step=i)   # at the logging cadence
+    tele.close()
+
+Everything degrades gracefully: with no directory the spans stay in memory
+(bench mode), with no active Telemetry the module-level `span()` is a
+reusable nullcontext, and instrumented library code (data loader, prefetch)
+only ever touches `span()` + the metrics registry — it keeps working
+unconfigured."""
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+from dalle_pytorch_tpu.observability import metrics as metrics_mod
+from dalle_pytorch_tpu.observability.heartbeat import Heartbeat
+from dalle_pytorch_tpu.observability.spans import SpanRecorder
+from dalle_pytorch_tpu.observability.xla import (
+    CompileWatcher,
+    FlopsCrosscheck,
+    record_memory_gauges,
+    step_cost_analysis,
+)
+
+_NULL = contextlib.nullcontext()
+_ACTIVE: Optional["Telemetry"] = None
+
+
+class Telemetry:
+    def __init__(
+        self,
+        dir: Optional[str] = None,
+        run_name: str = "run",
+        mirror_profiler: bool = True,
+        heartbeat_s: Optional[float] = None,
+        watch_compiles: bool = True,
+        process_index: int = 0,
+        flops_rtol: float = 0.5,
+    ):
+        self.dir = Path(dir) if dir is not None else None
+        self.run_name = run_name
+        suffix = "" if process_index == 0 else f".p{process_index}"
+        spans_path = (
+            str(self.dir / f"{run_name}{suffix}.spans.jsonl")
+            if self.dir is not None else None
+        )
+        self.spans = SpanRecorder(spans_path, mirror_profiler=mirror_profiler)
+        self.registry = metrics_mod.REGISTRY
+        self.compile_watcher: Optional[CompileWatcher] = None
+        if watch_compiles:
+            self.compile_watcher = CompileWatcher(
+                on_recompile=lambda ev: self.spans.write_event(
+                    "alarm", type="recompile", **{k: v for k, v in ev.items() if k != "ts"}
+                )
+            ).start()
+        self.heartbeat: Optional[Heartbeat] = None
+        if heartbeat_s is not None and heartbeat_s > 0:
+            self.heartbeat = Heartbeat(
+                heartbeat_s,
+                dir=str(self.dir) if self.dir is not None else None,
+                recorder=self.spans,
+                registry=self.registry,
+            ).start()
+        self._flops_check = FlopsCrosscheck(
+            1.0, rtol=flops_rtol,
+            on_alarm=lambda ev: self.spans.write_event("alarm", type="flops_divergence", **ev),
+        )
+        self._steps_seen = 0
+        self._closed = False
+
+    # -- spans --------------------------------------------------------------
+    def span(self, name: str, aggregate: bool = False, **attrs):
+        return self.spans.span(name, aggregate=aggregate, **attrs)
+
+    def begin_step(self, n: int):
+        self.spans.start_step(n)
+
+    def finish_step(self, n: int):
+        """Flush the step record, stamp the heartbeat, and arm the recompile
+        counter once the first step has completed (steady state)."""
+        self.spans.end_step()
+        self._steps_seen += 1
+        if self.heartbeat is not None:
+            self.heartbeat.beat(n)
+        if self._steps_seen == 1 and self.compile_watcher is not None:
+            # steady state: later compiles are recompilations
+            self.compile_watcher.arm()
+
+    def abort_step(self):
+        """Discard a step begun but never executed (empty data iterator)."""
+        self.spans.abort_step()
+
+    def step(self, n: int):
+        """Per-step context: groups this step's spans, stamps the heartbeat,
+        arms the recompile counter once the first step has completed."""
+        tele = self
+
+        class _StepCtx:
+            def __enter__(self):
+                tele.begin_step(n)
+                return tele
+
+            def __exit__(self, exc_type, *exc):
+                if exc_type is None:
+                    tele.finish_step(n)
+                else:
+                    tele.spans.end_step()
+                return False
+
+        return _StepCtx()
+
+    # -- metrics ------------------------------------------------------------
+    def flush(self, logger=None, step: Optional[int] = None) -> Dict[str, Any]:
+        """Sample memory gauges, snapshot the registry, and push it through
+        the MetricLogger (when given) + the telemetry JSONL."""
+        record_memory_gauges()
+        snap = self.registry.flush_to(logger, step=step)
+        if snap:
+            self.spans.write_event("metrics", step=step, metrics=snap)
+        return snap
+
+    # -- XLA ----------------------------------------------------------------
+    def crosscheck_flops(self, step_fn, args: Tuple, analytic_flops: float,
+                         label: str = "train_step") -> Optional[float]:
+        """Record XLA's FLOPs estimate for the step vs the analytic model;
+        feeds the persistent-divergence alarm.  Never raises."""
+        import contextlib as _ctx
+
+        suspend = (self.compile_watcher.suspended()
+                   if self.compile_watcher is not None else _ctx.nullcontext())
+        with suspend:  # the crosscheck's own lowering/compile is not a recompile
+            ca = step_cost_analysis(step_fn, *args)
+        if ca is None or "flops" not in ca:
+            return None
+        self._flops_check.analytic_flops = float(analytic_flops)
+        ratio = self._flops_check.check(ca["flops"])
+        self.spans.write_event(
+            "flops_crosscheck", label=label, analytic_flops=float(analytic_flops),
+            compiled_flops=ca["flops"], ratio=ratio,
+            bytes_accessed=ca.get("bytes accessed"),
+        )
+        return ratio
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"steps": self._steps_seen}
+        if self.compile_watcher is not None:
+            out.update(self.compile_watcher.summary())
+        if self._flops_check.last_ratio is not None:
+            out["flops_ratio"] = round(self._flops_check.last_ratio, 4)
+        return out
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        if self.compile_watcher is not None:
+            self.spans.write_event("compile_summary", **self.compile_watcher.summary())
+            self.compile_watcher.stop()
+        self.spans.write_event("run_end", ts_end=time.time())
+        self.spans.close()
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+
+# --- module-level plumbing ---------------------------------------------------
+
+def configure(dir: Optional[str] = None, run_name: str = "run", **kwargs) -> Telemetry:
+    """Create + install the process-wide Telemetry (closing any previous)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+    _ACTIVE = Telemetry(dir=dir, run_name=run_name, **kwargs)
+    return _ACTIVE
+
+
+def active() -> Optional[Telemetry]:
+    return _ACTIVE
+
+
+def span(name: str, aggregate: bool = False, **attrs):
+    """Span on the active Telemetry; a reusable no-op when none is
+    configured — library code can instrument unconditionally."""
+    tele = _ACTIVE
+    if tele is None:
+        return _NULL
+    return tele.spans.span(name, aggregate=aggregate, **attrs)

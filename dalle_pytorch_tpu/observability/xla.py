@@ -1,0 +1,299 @@
+"""XLA-level introspection: recompilation counting, device memory peaks, and
+compiled-vs-analytic FLOPs cross-checking.
+
+Three answers a TPU run must be able to give without a new round:
+
+* "are we compile-thrashed?" — `CompileWatcher` hooks `jax.monitoring`'s
+  compile-duration events (fired for every backend compile, no config
+  needed) and, when `jax_log_compiles` naming is available, captures the
+  compiled function names; any compile after `arm()` (i.e. after the first
+  step ran) is a RECOMPILE and increments a registry counter + fires a
+  callback (shape drift from a ragged last batch, a traced-scalar-turned-
+  static, etc. — each one costs minutes at flagship scale).
+* "are we memory-bound?" — `device_memory_stats()` reads
+  `device.memory_stats()` (bytes_in_use / peak_bytes_in_use on TPU; absent
+  on CPU) into gauges.
+* "is the analytic MFU accounting drifting?" — `step_cost_analysis()` pulls
+  XLA's own FLOPs estimate for the jitted step and `FlopsCrosscheck`
+  alarms when the compiled/analytic ratio diverges persistently (a silent
+  mask/density accounting bug would otherwise misprice MFU for rounds).
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from dalle_pytorch_tpu.observability import metrics as metrics_mod
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileWatcher:
+    """Counts XLA backend compiles; compiles after `arm()` are recompiles.
+
+    Uses two complementary hooks:
+      * `jax.monitoring` duration events — always fire, carry no name;
+      * a logging handler on jax's compile loggers (requires
+        `jax_log_compiles`, enabled while watching) — carries the jitted
+        function name for the event log.
+    """
+
+    def __init__(self, on_recompile: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 max_events: int = 64):
+        self._on_recompile = on_recompile
+        self._active = False
+        self._armed = False
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._max_events = max_events
+        self._pending_name: Optional[str] = None
+        self.compiles = 0
+        self.recompiles = 0
+        self.compile_time_s = 0.0
+        self._listener = None
+        self._handler = None
+        self._prev_log_compiles = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "CompileWatcher":
+        if self._active:
+            return self
+        self._active = True
+
+        def listener(event: str, duration: float, **kw):
+            if self._active and event == _COMPILE_EVENT:
+                self._on_compile_event(duration)
+
+        self._listener = listener
+        jax.monitoring.register_event_duration_secs_listener(listener)
+
+        # best-effort name capture: "Compiling <name> with global shapes..."
+        watcher = self
+
+        class _Handler(logging.Handler):
+            def emit(self, record):
+                try:
+                    m = re.match(r"Compiling ([^\s]+) with global shapes",
+                                 record.getMessage())
+                    if m is not None:
+                        watcher._pending_name = m.group(1)
+                except Exception:  # never let telemetry break compilation
+                    pass
+
+        try:
+            self._prev_log_compiles = jax.config.jax_log_compiles
+            jax.config.update("jax_log_compiles", True)
+            self._handler = _Handler(level=logging.DEBUG)
+            logging.getLogger("jax._src.interpreters.pxla").addHandler(self._handler)
+            if not self._prev_log_compiles:
+                # we turned log_compiles on only to read names — stop the
+                # records from ALSO spamming stderr through the jax logger's
+                # stream handler.  (A user who enabled log_compiles
+                # themselves wants the console output; leave theirs alone.)
+                # Every muted logger gets our handler too: a handler-less
+                # non-propagating logger would fall back to
+                # logging.lastResort, which prints bare messages to stderr.
+                self._muted = []
+                for name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+                    lg = logging.getLogger(name)
+                    self._muted.append((lg, lg.propagate))
+                    lg.propagate = False
+                    if self._handler not in lg.handlers:
+                        lg.addHandler(self._handler)
+        except Exception:  # pragma: no cover - cosmetic only
+            self._handler = None
+        return self
+
+    def stop(self):
+        self._active = False
+        if self._listener is not None:
+            try:  # no public unregister API; the private one exists for tests
+                from jax._src import monitoring as _m
+
+                _m._unregister_event_duration_listener_by_callback(self._listener)
+            except Exception:
+                pass  # inactive listener is a no-op either way
+            self._listener = None
+        if self._handler is not None:
+            logging.getLogger("jax._src.interpreters.pxla").removeHandler(self._handler)
+        for lg, prev in getattr(self, "_muted", []):
+            lg.propagate = prev
+            if self._handler is not None:
+                lg.removeHandler(self._handler)
+        self._muted = []
+        self._handler = None
+        if self._prev_log_compiles is not None:
+            try:
+                jax.config.update("jax_log_compiles", self._prev_log_compiles)
+            except Exception:  # pragma: no cover
+                pass
+            self._prev_log_compiles = None
+
+    def arm(self):
+        """Call once steady state is reached (first step done): every compile
+        after this is a recompilation worth alarming on."""
+        self._armed = True
+
+    def suspended(self):
+        """Context: ignore compile events inside (telemetry's OWN compiles —
+        e.g. a cost-analysis `.compile()` fallback — must not count as
+        recompiles, or the crosscheck-on-recompile trigger feeds back on
+        itself)."""
+        watcher = self
+
+        class _Suspend:
+            def __enter__(self):
+                self._was = watcher._active
+                watcher._active = False
+
+            def __exit__(self, *exc):
+                watcher._active = self._was
+                return False
+
+        return _Suspend()
+
+    # -- event path ---------------------------------------------------------
+    def _on_compile_event(self, duration: float):
+        with self._lock:
+            name, self._pending_name = self._pending_name, None
+            self.compiles += 1
+            self.compile_time_s += duration
+            armed = self._armed
+            if armed:
+                self.recompiles += 1
+            event = {"ts": time.time(), "dur_s": duration, "name": name,
+                     "recompile": armed, "n": self.compiles}
+            self._events.append(event)
+            del self._events[:-self._max_events]
+        metrics_mod.counter("xla_compiles").inc()
+        metrics_mod.counter("xla_compile_time_s").inc(duration)
+        if armed:
+            metrics_mod.counter("xla_recompiles").inc()
+            if self._on_recompile is not None:
+                try:
+                    self._on_recompile(event)
+                except Exception:  # pragma: no cover
+                    pass
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def summary(self) -> Dict[str, Any]:
+        return {"compiles": self.compiles, "recompiles": self.recompiles,
+                "compile_time_s": round(self.compile_time_s, 3)}
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, float]]:
+    """{bytes_in_use, peak_bytes_in_use, ...} for one device, or None where
+    the backend doesn't expose allocator stats (CPU)."""
+    try:
+        device = device if device is not None else jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: float(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
+def record_memory_gauges(device=None) -> Optional[Dict[str, float]]:
+    """Sample allocator stats into gauges; returns the sample (or None)."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return None
+    for key in ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size"):
+        if key in stats:
+            metrics_mod.gauge(f"device_{key}").set(stats[key])
+    return stats
+
+
+def step_cost_analysis(step_fn: Callable, *args) -> Optional[Dict[str, float]]:
+    """XLA's cost analysis for a jitted step: {'flops': ..., ...} or None.
+
+    Accepts either a jitted function or a wrapper exposing the jitted
+    callable as `.jitted` and (optionally) the mesh as `.mesh`
+    (parallel/train_step.py attaches both so the CLI's telemetry can reach
+    through its mesh-context closure).  Uses the unoptimized-HLO analysis
+    from `.lower()` — one extra trace, NO second backend compile."""
+    target = getattr(step_fn, "jitted", step_fn)
+    if not hasattr(target, "lower"):
+        return None
+    import contextlib
+
+    mesh = getattr(step_fn, "mesh", None)
+    ctx = contextlib.nullcontext()
+    if mesh is not None:
+        from dalle_pytorch_tpu.parallel.mesh import mesh_context
+
+        ctx = mesh_context(mesh)
+    try:
+        with ctx:
+            lowered = target.lower(*args)
+            try:
+                ca = lowered.cost_analysis()
+            except Exception:
+                ca = lowered.compile().cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {k: float(v) for k, v in ca.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+class FlopsCrosscheck:
+    """Tracks the compiled/analytic FLOPs ratio; a divergence past `rtol`
+    on `persistence` consecutive checks is an alarm (one-off lowering noise
+    is not — e.g. a fallback recompile with a ragged last batch).
+
+    The two estimates measure different things (cost_analysis sees the VAE
+    encode, remat recompute, and optimizer FLOPs the analytic model
+    excludes), so the alarm triggers on DRIFT from the first observed ratio,
+    not on distance from 1.0."""
+
+    def __init__(self, analytic_flops: float, rtol: float = 0.5,
+                 persistence: int = 2,
+                 on_alarm: Optional[Callable[[Dict[str, Any]], None]] = None):
+        self.analytic_flops = float(analytic_flops)
+        self.rtol = rtol
+        self.persistence = persistence
+        self.on_alarm = on_alarm
+        self.baseline_ratio: Optional[float] = None
+        self.last_ratio: Optional[float] = None
+        self._diverged = 0
+        self.alarmed = False
+
+    def check(self, measured_flops: float) -> Optional[float]:
+        if not measured_flops or self.analytic_flops <= 0:
+            return None
+        ratio = measured_flops / self.analytic_flops
+        self.last_ratio = ratio
+        metrics_mod.gauge("flops_compiled_over_analytic").set(ratio)
+        if self.baseline_ratio is None:
+            self.baseline_ratio = ratio
+            return ratio
+        drift = abs(ratio - self.baseline_ratio) / max(abs(self.baseline_ratio), 1e-12)
+        if drift > self.rtol:
+            self._diverged += 1
+            if self._diverged >= self.persistence and not self.alarmed:
+                self.alarmed = True
+                event = {"baseline_ratio": self.baseline_ratio, "ratio": ratio,
+                         "drift": drift, "analytic_flops": self.analytic_flops,
+                         "measured_flops": measured_flops}
+                metrics_mod.counter("flops_divergence_alarms").inc()
+                if self.on_alarm is not None:
+                    self.on_alarm(event)
+        else:
+            self._diverged = 0
+            self.alarmed = False
+        return ratio

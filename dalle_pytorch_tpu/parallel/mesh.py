@@ -83,8 +83,27 @@ class ContextMesh(Mesh):
 
     def __exit__(self, *exc):
         tokens = _MESH_TOKENS.get()
+        if not tokens:
+            raise RuntimeError(
+                "ContextMesh.__exit__ called with no matching __enter__ on "
+                "this context: the enter/exit token stack is empty.  This "
+                "happens when __exit__ runs in a different thread/context "
+                "than __enter__ (contextvars don't propagate backwards into "
+                "threads started before the enter), or when exits are "
+                "unbalanced (e.g. calling __exit__ twice).  Enter and exit "
+                "the mesh from the same thread, or use "
+                "dalle_pytorch_tpu.parallel.mesh.mesh_context()."
+            )
         _MESH_TOKENS.set(tokens[:-1])
-        _ACTIVE_MESH.reset(tokens[-1])
+        try:
+            _ACTIVE_MESH.reset(tokens[-1])
+        except ValueError as e:
+            raise RuntimeError(
+                "ContextMesh.__exit__: the innermost enter token is not "
+                "valid in this context — mesh enters/exits are interleaved "
+                "across threads or out of order (exit meshes in LIFO order, "
+                "from the thread that entered them)."
+            ) from e
         return super().__exit__(*exc)
 
 

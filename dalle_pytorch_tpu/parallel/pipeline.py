@@ -186,7 +186,10 @@ def pipeline_scan(
         ws = rebuild(fl_local, il_local)
         if fold_micro is not None:
             ws = fold_micro(ws, micro_id)
-        h, _ = jax.lax.scan(lambda hh, w: (body(hh, w)[0], None), h, ws)
+        # named per-stage region: xprof traces show the stage compute as its
+        # own labelled row, separating it from the ppermute hops and bubbles
+        with jax.named_scope("pp_stage_layers"):
+            h, _ = jax.lax.scan(lambda hh, w: (body(hh, w)[0], None), h, ws)
         return h
 
     fwd_perm = [(i, (i + 1) % stages) for i in range(stages)]
@@ -196,6 +199,7 @@ def pipeline_scan(
     def per_stage_fwd(fl_local, il_local, xm_in, with_saved: bool):
         s = jax.lax.axis_index(axis)
 
+        @jax.named_scope("pp_fwd_tick")
         def tick(carry, t):
             h, outs, saved, ring = carry
             if v > 1:
@@ -251,7 +255,8 @@ def pipeline_scan(
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs, jnp.where(write, h, prev), oc, 0
             )
-            h = jax.lax.ppermute(h, axis, fwd_perm)
+            with jax.named_scope("pp_ppermute_fwd"):
+                h = jax.lax.ppermute(h, axis, fwd_perm)
             return (h, outs, saved, ring), None
 
         var = lambda z: jax.lax.pcast(z, (axis,), to="varying")
@@ -302,6 +307,7 @@ def pipeline_scan(
         s = jax.lax.axis_index(axis)
         saved_local = saved_local[0]  # drop the (1,) stage-stacking dim
 
+        @jax.named_scope("pp_bwd_tick")
         def tick(carry, u):
             dh, dfl, dx, dring = carry
             # virtual micro handled this tick, in REVERSE order
@@ -365,7 +371,8 @@ def pipeline_scan(
                 lambda d: d,
                 dx,
             )
-            dh = jax.lax.ppermute(dh, axis, bwd_perm)
+            with jax.named_scope("pp_ppermute_bwd"):
+                dh = jax.lax.ppermute(dh, axis, bwd_perm)
             return (dh, dfl, dx, dring), None
 
         var = lambda z: jax.lax.pcast(z, (axis,), to="varying")

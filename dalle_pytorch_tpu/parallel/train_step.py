@@ -121,6 +121,11 @@ def make_train_step(
     ls_dynamic = settings.loss_scale == "dynamic"
     ls_init = 2.0 ** 15 if ls_dynamic else float(settings.loss_scale or 1.0)
     LS_GROWTH_INTERVAL = 2000
+    # growth ceiling: past 2^24 the scale itself overflows bf16/f32 gradient
+    # headroom — the first overflow then halves-and-skips, 2000 clean steps
+    # double it back over the edge, and the skip-step branch wedges into a
+    # permanent skip/halve/grow limit cycle.  DeepSpeed/AMP cap here too.
+    LS_MAX = 2.0 ** 24
 
     lowp = settings.param_dtype is not None and jnp.dtype(settings.param_dtype).itemsize < 4
     sr = settings.stochastic_round if settings.stochastic_round is not None else lowp
@@ -220,20 +225,25 @@ def make_train_step(
             scale = ls["loss_scale"]
         else:
             inner_opt_state, ls, scale = state.opt_state, None, None
-        grads, loss = grads_and_loss(state.params, batch, key, scale=scale)
-        # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
-        # no f32 copy of the gradient buffer is materialized)
-        gnorm = jnp.sqrt(sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree_util.tree_leaves(grads)
-        ))
-        if settings.clip_grad_norm is not None:
-            factor = jnp.minimum(1.0, settings.clip_grad_norm / (gnorm + 1e-6))
-            grads = jax.tree_util.tree_map(
-                lambda g: g * factor.astype(g.dtype), grads
-            )
-            gnorm = gnorm * factor  # the metric reports the applied norm
+        # named scopes land in the HLO metadata, so these phases show up as
+        # labelled regions in xprof/TensorBoard traces of the step
+        with jax.named_scope("fwd_bwd"):
+            grads, loss = grads_and_loss(state.params, batch, key, scale=scale)
+        with jax.named_scope("grad_norm"):
+            # norm in f32 regardless of grad_dtype (per-leaf fused reductions,
+            # no f32 copy of the gradient buffer is materialized)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads)
+            ))
+            if settings.clip_grad_norm is not None:
+                factor = jnp.minimum(1.0, settings.clip_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * factor.astype(g.dtype), grads
+                )
+                gnorm = gnorm * factor  # the metric reports the applied norm
 
+        @jax.named_scope("optimizer_update")
         def do_update(grads, opt_state, params, rk):
             if lowp:
                 # optimizer math in f32 (the casts fuse into the update
@@ -274,7 +284,8 @@ def make_train_step(
             grow = good >= LS_GROWTH_INTERVAL
             new_scale = jnp.where(
                 finite,
-                jnp.where(grow, ls["loss_scale"] * 2.0, ls["loss_scale"]),
+                jnp.where(grow, jnp.minimum(ls["loss_scale"] * 2.0, LS_MAX),
+                          ls["loss_scale"]),
                 jnp.maximum(ls["loss_scale"] * 0.5, 1.0),
             )
             good = jnp.where(grow, 0, good)
@@ -313,4 +324,8 @@ def make_train_step(
         with mesh_context(mesh):
             return jitted(state, batch, key)
 
+    # telemetry reaches through the closure: observability.step_cost_analysis
+    # lowers `.jitted` inside `.mesh`'s context for the XLA FLOPs cross-check
+    with_mesh_ctx.jitted = jitted
+    with_mesh_ctx.mesh = mesh
     return init_fn, with_mesh_ctx

@@ -219,14 +219,19 @@ def _load_leaves(data, name: str, n: int) -> List[np.ndarray]:
     return leaves
 
 
-def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+def load_checkpoint(
+    path: str, allow_legacy_pickle: bool = False
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Returns (trees, meta).
 
     v3 files load without any unpickling: pure-container trees (all weights
     trees) come back with their exact structure; library-structured trees
     (optimizer states) come back as TreeBundle — pass those through
     `unflatten_like(template, bundle)`.  v1/v2 files carry pickled treedefs
-    and are only safe to load from trusted sources."""
+    — an arbitrary-code-execution vector on untrusted files — so loading
+    them requires the explicit `allow_legacy_pickle=True` opt-in (otherwise
+    a crafted "old-format" file would silently downgrade the no-pickle
+    guarantee the v3 format exists for)."""
     with np.load(path, allow_pickle=False) as data:
         fmt = int(data["__format"]) if "__format" in data.files else 1
         if fmt > FORMAT_VERSION:
@@ -250,6 +255,16 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
                 else:
                     trees[name] = TreeBundle(paths, leaves)
         else:
+            if not allow_legacy_pickle:
+                raise ValueError(
+                    f"checkpoint {path!r} is a legacy v{fmt} file whose tree "
+                    "structure is stored as a pickle — refusing to unpickle by "
+                    "default (a crafted file could execute code on load).  If "
+                    "the file comes from a trusted source, load it with "
+                    "load_checkpoint(path, allow_legacy_pickle=True) and "
+                    "re-save it to migrate to the pickle-free v3 format "
+                    "(save_checkpoint writes v3)."
+                )
             import pickle  # legacy formats only (see docstring)
 
             names = {

@@ -91,16 +91,22 @@ def test_format_version_stamped_and_checked(tmp_path):
     legacy = tmp_path / "legacy.pt"
     with open(legacy, "wb") as f:
         np.savez(f, **legacy_payload)
-    loaded, meta = load_checkpoint(str(legacy))
+    # legacy formats unpickle their treedefs — loading them now requires the
+    # explicit trusted-source opt-in (format-downgrade hole, ADVICE.md)
+    with pytest.raises(ValueError, match="allow_legacy_pickle"):
+        load_checkpoint(str(legacy))
+    loaded, meta = load_checkpoint(str(legacy), allow_legacy_pickle=True)
     assert meta["epoch"] == 0
     np.testing.assert_array_equal(np.asarray(loaded["w"]["x"]), np.ones(2))
 
-    # v2 file (stamped, pickled treedef) also still loads
+    # v2 file (stamped, pickled treedef) also still loads with the opt-in
     legacy_payload["__format"] = np.array(2, dtype=np.int64)
     v2 = tmp_path / "v2.pt"
     with open(v2, "wb") as f:
         np.savez(f, **legacy_payload)
-    loaded, _ = load_checkpoint(str(v2))
+    with pytest.raises(ValueError, match="legacy v2"):
+        load_checkpoint(str(v2))
+    loaded, _ = load_checkpoint(str(v2), allow_legacy_pickle=True)
     np.testing.assert_array_equal(np.asarray(loaded["w"]["x"]), np.ones(2))
 
 

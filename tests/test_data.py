@@ -304,6 +304,63 @@ def test_tar_pipeline_http_retry_then_success(tar_shard):
     assert attempts["n"] == 3 and len(items) == 2
 
 
+def test_http_4xx_fails_fast_5xx_retries():
+    """A permanent 4xx (typo'd shard prefix -> 404) must NOT be retried —
+    one attempt, immediate HTTPError; 5xx server errors keep the bounded
+    retry loop."""
+    import urllib.error
+    import urllib.request
+
+    import pytest
+
+    from dalle_pytorch_tpu.data.loader import _open_remote
+
+    def make_fake(code):
+        calls = {"n": 0}
+
+        def fake(req, timeout=None):
+            calls["n"] += 1
+            raise urllib.error.HTTPError(
+                "https://host/s.tar", code, "err", hdrs=None, fp=None
+            )
+
+        return fake, calls
+
+    real = urllib.request.urlopen
+    try:
+        for code in (403, 404):
+            fake, calls = make_fake(code)
+            urllib.request.urlopen = fake
+            with pytest.raises(urllib.error.HTTPError):
+                _open_remote("https://host/s.tar", retries=3, timeout=1.0)
+            assert calls["n"] == 1, f"{code} must not be retried"
+        for code in (429, 500, 503):  # transient: full retry budget
+            fake, calls = make_fake(code)
+            urllib.request.urlopen = fake
+            with pytest.raises(urllib.error.HTTPError):
+                _open_remote("https://host/s.tar", retries=3, timeout=1.0)
+            assert calls["n"] == 3, f"{code} should retry"
+    finally:
+        urllib.request.urlopen = real
+
+
+def test_prefetch_records_queue_depth_and_transfer_bytes():
+    """The prefetch pipeline feeds the telemetry registry: queue-depth gauge
+    + host->device byte counter."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.data.loader import prefetch_to_device
+    from dalle_pytorch_tpu.observability import REGISTRY
+
+    before = REGISTRY.counter("host_to_device_bytes").value
+    batches = [{"x": np.ones((2, 4), np.float32)} for _ in range(3)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 3
+    moved = REGISTRY.counter("host_to_device_bytes").value - before
+    assert moved == 3 * 2 * 4 * 4
+    assert REGISTRY.gauge("data_queue_depth").value is not None
+
+
 # --- native C++ BPE ----------------------------------------------------------
 
 def test_native_bpe_matches_python():

@@ -739,6 +739,38 @@ def test_loss_scale_dynamic_overflow_skips_and_halves():
     assert not np.allclose(np.asarray(state.params["w"]), np.ones((4, 4)))
 
 
+def test_loss_scale_growth_clamped_at_2_pow_24():
+    """Dynamic scale growth must cap at 2^24: unbounded doubling every 2000
+    clean steps eventually overflows the scale itself and wedges the
+    skip-step branch into a permanent skip/halve/grow limit cycle."""
+    def loss_fn(p, batch, key):
+        return jnp.sum(p["w"] ** 2)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, optax.sgd(0.0), settings=StepSettings(loss_scale="dynamic")
+    )
+    state = init_fn({"w": jnp.ones((4,))})
+    inner, _ = state.opt_state
+    # one clean step away from a growth event, already at the ceiling
+    ls = {"loss_scale": jnp.asarray(2.0 ** 24, jnp.float32),
+          "good_steps": jnp.asarray(1999, jnp.int32)}
+    state = TrainState(state.step, state.params, (inner, ls))
+    state, m = step_fn(state, {}, jax.random.PRNGKey(0))
+    assert int(m["skipped"]) == 0
+    assert float(state.opt_state[1]["loss_scale"]) == 2.0 ** 24  # clamped
+    assert int(state.opt_state[1]["good_steps"]) == 0  # growth event consumed
+
+
+def test_context_mesh_unbalanced_exit_raises_descriptive():
+    """__exit__ with no matching __enter__ must raise a descriptive
+    RuntimeError, not an IndexError from the token stack."""
+    mesh = make_mesh(MeshConfig())
+    with mesh:
+        pass
+    with pytest.raises(RuntimeError, match="no matching __enter__"):
+        mesh.__exit__(None, None, None)
+
+
 def test_loss_scale_with_grad_accum_and_bf16_storage():
     """Loss scaling composes with microbatch accumulation and pure-bf16
     param storage (the full fp16-parity recipe in one step)."""

@@ -1,8 +1,12 @@
-"""MFU accounting: pattern-aware attention FLOPs.
+"""MFU accounting (pattern-aware attention FLOPs) + the telemetry subsystem
+(structured spans, metrics registry, XLA introspection, heartbeat).
 
 The reference prices every layer at full causal cost (it has no MFU counter
 at all — SURVEY.md §5); here masked-out attention positions must NOT count as
 useful FLOPs, since the Pallas kernels skip dead tiles."""
+import json
+import time
+
 import numpy as np
 
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -49,3 +53,252 @@ def test_step_flops_scale_with_density():
     assert f_mixed < f_full
     # projection FLOPs are unchanged; only the attention term shrinks
     assert f_mixed > 3 * 2 * 10_000 * 2 * cfg_full.total_seq_len
+
+
+# --- telemetry: structured spans --------------------------------------------
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def test_span_nesting_and_jsonl_schema(tmp_path):
+    """Nested spans record full paths; the per-step summary attributes time
+    to top-level spans only and folds aggregate spans into counts."""
+    from dalle_pytorch_tpu.observability import telemetry as tele_mod
+
+    tele = tele_mod.configure(dir=str(tmp_path), run_name="t",
+                              heartbeat_s=None, watch_compiles=False)
+    try:
+        with tele.step(0):
+            with tele_mod.span("data_wait"):
+                pass
+            with tele_mod.span("dispatch"):
+                with tele_mod.span("inner"):
+                    time.sleep(0.01)
+            for _ in range(3):
+                with tele_mod.span("decode", aggregate=True):
+                    pass
+    finally:
+        tele.close()
+
+    recs = _read_jsonl(tmp_path / "t.spans.jsonl")
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert {"data_wait", "dispatch", "dispatch/inner"} <= {r["path"] for r in spans}
+    for r in spans:  # schema: every span record carries these fields
+        assert {"name", "path", "ts", "dur_s", "step"} <= set(r)
+        assert r["step"] == 0
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert len(steps) == 1 and steps[0]["step"] == 0
+    # top-level attribution excludes nested spans (no double counting)
+    assert set(steps[0]["spans"]) == {"data_wait", "dispatch"}
+    assert steps[0]["spans"]["dispatch"] >= 0.01
+    assert steps[0]["dur_s"] >= steps[0]["spans"]["dispatch"]
+    # aggregate spans: count + total only, no per-sample records
+    assert steps[0]["agg"]["decode"]["n"] == 3
+    assert not any(r["path"] == "decode" for r in spans)
+
+
+def test_span_noop_without_configuration():
+    """Library instrumentation must be a no-op when telemetry is off."""
+    from dalle_pytorch_tpu.observability import telemetry as tele_mod
+
+    assert tele_mod.active() is None
+    with tele_mod.span("anything"):
+        pass  # must not raise
+
+
+def test_abort_step_discards_partial_record(tmp_path):
+    from dalle_pytorch_tpu.observability import telemetry as tele_mod
+
+    tele = tele_mod.configure(dir=str(tmp_path), run_name="a",
+                              heartbeat_s=None, watch_compiles=False)
+    try:
+        tele.begin_step(0)
+        with tele_mod.span("data_wait"):
+            pass
+        tele.abort_step()  # epoch-end: the wait found an empty iterator
+        with tele.step(1):
+            with tele_mod.span("dispatch"):
+                pass
+    finally:
+        tele.close()
+    steps = [r for r in _read_jsonl(tmp_path / "a.spans.jsonl") if r["kind"] == "step"]
+    assert [s["step"] for s in steps] == [1]
+
+
+# --- telemetry: metrics registry --------------------------------------------
+
+def test_metrics_registry_flushes_through_metric_logger(tmp_path):
+    from dalle_pytorch_tpu.observability import MetricsRegistry
+    from dalle_pytorch_tpu.training.logging import MetricLogger
+
+    reg = MetricsRegistry()
+    reg.counter("steps").inc(3)
+    reg.gauge("queue_depth").set(2)
+    reg.gauge("queue_depth").set(1)  # max survives in the window stats
+    reg.histogram("save_s").observe(0.25)
+    logger = MetricLogger(run_name="r", log_dir=str(tmp_path))
+    snap = reg.flush_to(logger, step=7)
+    logger.finish()
+
+    assert snap["steps"]["total"] == 3 and snap["steps"]["delta"] == 3
+    assert snap["queue_depth"]["last"] == 1 and snap["queue_depth"]["max"] == 2
+    assert snap["save_s"]["count"] == 1 and abs(snap["save_s"]["mean"] - 0.25) < 1e-9
+
+    recs = _read_jsonl(tmp_path / "r.metrics.jsonl")
+    tele_recs = [r for r in recs if "telemetry" in r]
+    assert len(tele_recs) == 1 and tele_recs[0]["step"] == 7
+    assert tele_recs[0]["telemetry"]["steps"]["kind"] == "counter"
+
+    # window deltas reset on flush; totals persist
+    reg.counter("steps").inc(1)
+    snap2 = reg.snapshot()
+    assert snap2["steps"]["total"] == 4 and snap2["steps"]["delta"] == 1
+
+
+def test_metrics_registry_kind_collision_raises():
+    import pytest
+
+    from dalle_pytorch_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+# --- telemetry: XLA introspection -------------------------------------------
+
+def test_recompile_counter_fires_on_shape_change():
+    """Compiles after arm() are recompilations; cache hits are not."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.observability import CompileWatcher
+
+    w = CompileWatcher().start()
+    try:
+        f = jax.jit(lambda x: x * 2 + 1)
+        f(jnp.arange(4.0)).block_until_ready()
+        assert w.compiles >= 1
+        assert w.recompiles == 0  # not armed: warmup compiles are expected
+        w.arm()
+        f(jnp.arange(4.0)).block_until_ready()  # cache hit
+        assert w.recompiles == 0
+        f(jnp.arange(6.0)).block_until_ready()  # shape change -> recompile
+        assert w.recompiles >= 1
+        assert w.summary()["recompiles"] == w.recompiles
+        assert any(e["recompile"] for e in w.events)
+    finally:
+        w.stop()
+
+
+def test_step_cost_analysis_and_flops_divergence_alarm():
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.observability import FlopsCrosscheck, step_cost_analysis
+
+    f = jax.jit(lambda a, b: a @ b)
+    ca = step_cost_analysis(f, jnp.ones((16, 16)), jnp.ones((16, 16)))
+    assert ca is not None and ca["flops"] > 0
+
+    alarms = []
+    chk = FlopsCrosscheck(1000.0, rtol=0.5, persistence=2, on_alarm=alarms.append)
+    assert chk.check(1200.0) == 1.2  # establishes the baseline ratio
+    chk.check(1300.0)  # within tolerance of baseline
+    chk.check(5000.0)  # first divergence: not yet persistent
+    assert not alarms
+    chk.check(5000.0)  # second consecutive: alarm
+    assert len(alarms) == 1 and alarms[0]["drift"] > 0.5
+
+
+def test_device_memory_stats_none_or_dict():
+    from dalle_pytorch_tpu.observability import device_memory_stats
+
+    stats = device_memory_stats()
+    assert stats is None or isinstance(stats, dict)  # CPU: usually None
+
+
+# --- telemetry: heartbeat / hang monitor ------------------------------------
+
+def test_heartbeat_hang_dump(tmp_path):
+    from dalle_pytorch_tpu.observability import Heartbeat, SpanRecorder
+
+    rec = SpanRecorder(str(tmp_path / "s.spans.jsonl"))
+    rec.start_step(3)
+    with rec.span("dispatch"):
+        pass
+    rec.end_step()
+    hb = Heartbeat(0.2, dir=str(tmp_path), recorder=rec, poll_s=0.05).start()
+    try:
+        hb.beat(step=3)
+        deadline = time.time() + 5.0
+        while hb.hangs == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert hb.hangs == 1
+        dumps = list(tmp_path.glob("hang_*.txt"))
+        assert len(dumps) == 1
+        text = dumps[0].read_text()
+        assert "HANG" in text and "last step 3" in text
+        assert "thread stacks" in text and "dispatch" in text
+        # one dump per hang, not a stream
+        time.sleep(0.5)
+        assert hb.hangs == 1
+        # a beat re-arms the monitor
+        hb.beat(step=4)
+        deadline = time.time() + 5.0
+        while hb.hangs < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert hb.hangs == 2
+    finally:
+        hb.stop()
+        rec.close()
+    hang_events = [r for r in _read_jsonl(tmp_path / "s.spans.jsonl")
+                   if r["kind"] == "hang"]
+    assert len(hang_events) == 2 and hang_events[0]["last_step"] == 3
+
+
+# --- telemetry: report rendering --------------------------------------------
+
+def _load_report_module():
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "tools" / "telemetry_report.py"
+    spec = importlib.util.spec_from_file_location("telemetry_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_telemetry_report_renders_attribution_table(tmp_path):
+    report = _load_report_module()
+    path = tmp_path / "x.spans.jsonl"
+    recs = [
+        {"kind": "meta", "schema": 1, "ts": 0.0},
+        {"kind": "step", "step": 0, "ts": 1.0, "dur_s": 1.0,
+         "spans": {"data_wait": 0.6, "dispatch": 0.1, "block": 0.2},
+         "agg": {"decode": {"n": 8, "total_s": 0.5}}},
+        {"kind": "step", "step": 1, "ts": 2.0, "dur_s": 0.5,
+         "spans": {"data_wait": 0.05, "dispatch": 0.05, "block": 0.35},
+         "agg": {}},
+        {"kind": "flops_crosscheck", "label": "train_step", "ratio": 1.8,
+         "analytic_flops": 1e9, "compiled_flops": 1.8e9},
+        {"kind": "alarm", "type": "recompile", "ts": 3.0, "dur_s": 0.2, "n": 2},
+        {"kind": "compile_summary", "compiles": 2, "recompiles": 1,
+         "compile_time_s": 0.4},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    out = report.build_report(report.load_records(str(path)))
+    assert "per-step time attribution" in out
+    assert "data_wait" in out and "dispatch" in out and "block" in out
+    assert "60.0%" in out  # step 0 data_wait share
+    assert "aggregate over 2 steps" in out
+    assert "decode" in out and "n=8" in out
+    assert "ratio=1.8" in out
+    assert "recompiles after steady state: 1" in out
+    assert "ALARMS (1)" in out
+    # a directory argument resolves to the spans file inside it
+    out2 = report.build_report(report.load_records(str(tmp_path)))
+    assert out2 == out

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render a telemetry spans JSONL into a per-step time-attribution table.
+
+    python tools/telemetry_report.py /tmp/tele/dalle.spans.jsonl
+    python tools/telemetry_report.py /tmp/tele            # picks *.spans.jsonl
+
+For each step record it attributes wall-clock to the top-level spans
+(data_wait / dispatch / block / checkpoint / log / ...) and prints a
+percentage table plus an aggregate attribution, the aggregate-span stats
+(decode etc.), and any alarms (recompiles, FLOPs divergence, hangs) — the
+"data-starved, compile-thrashed, collective-bound, or kernel-bound?" answer
+in one screen.  Pure stdlib; works on a partially-written file from a live
+run."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    p = Path(path)
+    if p.is_dir():
+        candidates = sorted(p.glob("*.spans.jsonl"))
+        if not candidates:
+            raise SystemExit(f"no *.spans.jsonl under {p}")
+        p = candidates[0]
+    records = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a live run
+    return records
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.4f}" if v < 10 else f"{v:.2f}"
+
+
+def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
+    steps = [r for r in records if r.get("kind") == "step" and r.get("step") is not None]
+    alarms = [r for r in records if r.get("kind") in ("alarm", "hang")]
+    checks = [r for r in records if r.get("kind") == "flops_crosscheck"]
+    compile_summaries = [r for r in records if r.get("kind") == "compile_summary"]
+    metrics = [r for r in records if r.get("kind") == "metrics"]
+
+    out: List[str] = []
+    if not steps:
+        out.append("no step records found (run with telemetry enabled?)")
+    else:
+        names: List[str] = []
+        for s in steps:
+            for k in s.get("spans", {}):
+                if k not in names:
+                    names.append(k)
+        other_needed = any(
+            s.get("dur_s", 0) - sum(s.get("spans", {}).values()) > 1e-9 for s in steps
+        )
+        cols = names + (["other"] if other_needed else [])
+        header = f"{'step':>6} {'total_s':>8} " + " ".join(f"{n + ' %':>12}" for n in cols)
+        out.append("per-step time attribution")
+        out.append(header)
+        out.append("-" * len(header))
+        shown = steps if len(steps) <= max_rows else steps[:max_rows // 2] + steps[-max_rows // 2:]
+        prev = None
+        for s in shown:
+            if prev is not None and s is not prev and steps.index(s) != steps.index(prev) + 1:
+                out.append(f"{'...':>6}")
+            prev = s
+            total = s.get("dur_s") or 0.0
+            spans = s.get("spans", {})
+            row = [f"{s['step']:>6}", f"{_fmt_s(total):>8}"]
+            accounted = 0.0
+            for n in names:
+                v = spans.get(n, 0.0)
+                accounted += v
+                pct = 100.0 * v / total if total > 0 else 0.0
+                row.append(f"{pct:>11.1f}%")
+            if other_needed:
+                pct = 100.0 * max(total - accounted, 0.0) / total if total > 0 else 0.0
+                row.append(f"{pct:>11.1f}%")
+            out.append(" ".join(row))
+
+        # aggregate attribution over all steps
+        total_all = sum(s.get("dur_s") or 0.0 for s in steps)
+        out.append("")
+        out.append(f"aggregate over {len(steps)} steps, {_fmt_s(total_all)}s total")
+        accounted = 0.0
+        for n in names:
+            v = sum(s.get("spans", {}).get(n, 0.0) for s in steps)
+            accounted += v
+            pct = 100.0 * v / total_all if total_all > 0 else 0.0
+            out.append(f"  {n:<16} {_fmt_s(v):>10}s  {pct:>5.1f}%")
+        if other_needed and total_all > 0:
+            v = max(total_all - accounted, 0.0)
+            out.append(f"  {'other':<16} {_fmt_s(v):>10}s  {100.0 * v / total_all:>5.1f}%")
+
+        # aggregate spans (per-sample work folded into counts)
+        agg: Dict[str, List[float]] = {}
+        for s in steps:
+            for k, rec in s.get("agg", {}).items():
+                slot = agg.setdefault(k, [0, 0.0])
+                slot[0] += rec.get("n", 0)
+                slot[1] += rec.get("total_s", 0.0)
+        if agg:
+            out.append("")
+            out.append("aggregated spans (count, total, mean)")
+            for k, (n, t) in sorted(agg.items()):
+                mean = t / n if n else 0.0
+                out.append(f"  {k:<24} n={n:<8} total={_fmt_s(t)}s mean={mean * 1e3:.2f}ms")
+
+    if checks:
+        out.append("")
+        out.append("FLOPs cross-checks (compiled cost_analysis / analytic)")
+        for c in checks:
+            ratio = c.get("ratio")
+            out.append(
+                f"  {c.get('label', '?')}: ratio={ratio if ratio is None else round(ratio, 4)} "
+                f"(compiled={c.get('compiled_flops'):.3e}, analytic={c.get('analytic_flops'):.3e})"
+            )
+    if compile_summaries:
+        cs = compile_summaries[-1]
+        out.append("")
+        out.append(
+            f"compiles: {cs.get('compiles', 0)} "
+            f"(recompiles after steady state: {cs.get('recompiles', 0)}, "
+            f"{cs.get('compile_time_s', 0)}s total)"
+        )
+    if metrics:
+        last = metrics[-1].get("metrics", {})
+        if last:
+            out.append("")
+            out.append(f"last metrics snapshot (step {metrics[-1].get('step')})")
+            for name, rec in sorted(last.items()):
+                brief = {k: v for k, v in rec.items()
+                         if k not in ("log2_buckets", "kind") and v is not None}
+                out.append(f"  {name:<32} {brief}")
+    out.append("")
+    if alarms:
+        out.append(f"ALARMS ({len(alarms)}):")
+        for a in alarms:
+            detail = {k: v for k, v in a.items() if k not in ("kind", "ts")}
+            out.append(f"  [{a['kind']}] {detail}")
+    else:
+        out.append("alarms: none")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="spans JSONL file, or a telemetry directory")
+    parser.add_argument("--max-rows", type=int, default=40,
+                        help="max per-step rows to print (head+tail beyond)")
+    args = parser.parse_args(argv)
+    try:
+        print(build_report(load_records(args.path), max_rows=args.max_rows))
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
