@@ -269,6 +269,27 @@ def main():
         sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1
     )
 
+    # diagnostic-step overhead (ISSUE 2): step time with the in-graph health
+    # diagnostics (with_health=True — per-leaf norms, nonfinite masks, the
+    # activation-tap probe forward) vs the plain step.  This is the cost of a
+    # `--health_every 1` run; at cadence N the amortized tax is 1/N of it,
+    # and the plain executable is unchanged (zero overhead when off).
+    state, hm = step_fn(state, batch_data, jax.random.PRNGKey(300), with_health=True)
+    float(hm["loss"])  # compile + settle the second executable
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, hm = step_fn(
+            state, batch_data, jax.random.PRNGKey(301 + i), with_health=True
+        )
+    float(hm["loss"])
+    health_step_time = (time.perf_counter() - t0) / steps
+    health_row = {
+        "health_step_time_s": round(health_step_time, 4),
+        "plain_step_time_s": round(step_time, 4),
+        "overhead_frac": round(health_step_time / step_time - 1.0, 4),
+        "tracked_leaves": len(jax.tree_util.tree_leaves(state.params)),
+    }
+
     # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same
     # model; plus the FULL generate-images pipeline (codes -> VAE decode ->
     # CLIP scores), the generate.py-with-rerank path the BASELINE row names
@@ -444,6 +465,7 @@ def main():
     common = {
         "proxy_dim2048_depth8": proxy_row,
         "telemetry": telemetry_row,
+        "health_overhead": health_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

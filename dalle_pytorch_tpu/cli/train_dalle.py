@@ -26,6 +26,7 @@ from dalle_pytorch_tpu.data.loader import (
 )
 from dalle_pytorch_tpu.models import dalle as dalle_mod
 from dalle_pytorch_tpu.models import vae_registry
+from dalle_pytorch_tpu.observability import health_host as health_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -186,6 +187,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "per-step time splits into data_wait / dispatch "
                              "/ block; 0: never block (dispatch-ahead "
                              "preserved, block time reads as 0)")
+    # training-health diagnostics (observability/health.py)
+    parser.add_argument("--health_every", type=int, default=0, metavar="N",
+                        help="run the in-graph health diagnostic step every N "
+                             "steps (0 disables): per-layer grad/param/update "
+                             "norms, NaN/Inf localization, attention/codebook "
+                             "activation taps, divergence alarms.  Compiles a "
+                             "second step executable; the normal step's HLO "
+                             "is unchanged (zero overhead when off)")
+    parser.add_argument("--health_inject_nan", type=str, default=None,
+                        metavar="STEP[:PATTERN]",
+                        help="test hook: poison the first param leaf whose "
+                             "path contains PATTERN (default: first leaf) "
+                             "with NaN before step STEP — exercises NaN "
+                             "localization + the alarm path end to end")
     parser.add_argument("--dummy_run", "--dummy-run", type=int, nargs="?",
                         const=6, default=None, metavar="N",
                         help="telemetry smoke mode: train N steps (default 6) "
@@ -251,7 +266,7 @@ def reconstitute_vae(args, resume=None):
 
 
 def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
-               global_step=0, wandb_run_id=None):
+               global_step=0, wandb_run_id=None, health_state=None):
     class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
     save_checkpoint(
         path,
@@ -269,6 +284,7 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
             "version": __version__,
             "vae_class_name": class_name,
             "scheduler_state": None,
+            "health_state": health_state,
         },
     )
     if keep_n is not None:
@@ -289,7 +305,8 @@ def _rotation_glob(path) -> str:
 
 
 def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
-                       keep_n=None, global_step=0, wandb_run_id=None):
+                       keep_n=None, global_step=0, wandb_run_id=None,
+                       health_state=None):
     """Distributed save: the TrainState goes through orbax, each host writing
     only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
     never gathered (`save_model`'s np.asarray would pull the full arrays to
@@ -305,6 +322,7 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
         "version": __version__,
         "vae_class_name": class_name,
         "scheduler_state": None,
+        "health_state": health_state,
     }
     path = Path(path)
     save_sharded(
@@ -662,6 +680,29 @@ def main(argv=None):
             print(f"[telemetry] spans + metrics + hang dumps -> {tele_dir} "
                   f"(render with tools/telemetry_report.py)")
 
+    # training-health diagnostics: per-layer numerics + divergence alarms on
+    # a second jitted step every --health_every steps (observability/health)
+    health_monitor = None
+    health_paths = None
+    if args.health_every:
+        health_paths = health_mod.leaf_paths(state.params)
+        health_monitor = health_mod.DivergenceMonitor(
+            on_alarm=health_mod.make_alarm_writer(tele, registry=obs_metrics.REGISTRY)
+        )
+        # alarm state (EMA, divergence onset) survives restarts through the
+        # checkpoint metadata — a resumed run keeps its armed thresholds
+        health_monitor.load_state_dict((resume_meta or {}).get("health_state"))
+        if is_root:
+            print(f"[health] diagnostics every {args.health_every} step(s) "
+                  f"({len(health_paths)} tracked param leaves; render with "
+                  "tools/health_report.py)")
+    inject_step = None
+    inject_pattern = ""
+    if args.health_inject_nan is not None:
+        part = args.health_inject_nan.split(":", 1)
+        inject_step = int(part[0])
+        inject_pattern = part[1] if len(part) > 1 else ""
+
     out_file = f"{args.dalle_output_file_name}.pt"
     start_epoch = (resume_meta or {}).get("epoch", 0)
     # restoring the step counter keeps save/sample cadences and checkpoint
@@ -678,7 +719,9 @@ def main(argv=None):
             fn(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                keep_n=keep_n,
                global_step=global_step if step is None else step,
-               wandb_run_id=logger.run_id)
+               wandb_run_id=logger.run_id,
+               health_state=(health_monitor.state_dict()
+                             if health_monitor is not None else None))
         obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
         obs_metrics.counter("checkpoints_saved").inc()
 
@@ -694,6 +737,12 @@ def main(argv=None):
     first_window = True
     flops_checked = False
     checked_recompiles = 0
+    # the plain and diagnostic steps are two executables; the FIRST dispatch
+    # of each variant legitimately compiles and must not read as a
+    # steady-state recompile alarm (e.g. step 0 is a health step, so the
+    # plain executable first compiles at step 1 — after the watcher armed)
+    compiled_variants = set()
+    import contextlib as _ctx
     for epoch in range(start_epoch, args.epochs):
         t_window = time.time()
         window_start = global_step  # reset with t_window: a stale window
@@ -753,8 +802,44 @@ def main(argv=None):
                     if is_root and ratio is not None:
                         print(f"[telemetry] compiled/analytic FLOPs ratio: "
                               f"{ratio:.3f}")
-            with telemetry.span("dispatch"):
-                state, metrics = step_fn(state, device_batch, sk)
+            health_step = bool(args.health_every) and (
+                global_step % args.health_every == 0
+            )
+            if inject_step is not None and global_step == inject_step:
+                # test hook: poison one param leaf so the localization path
+                # (finite-mask -> first offending path -> alarm) is exercised
+                state = TrainState(
+                    state.step,
+                    health_mod.inject_nan(state.params, inject_pattern),
+                    state.opt_state,
+                )
+                if is_root:
+                    print(f"[health] injected NaN into params "
+                          f"(pattern {inject_pattern!r}) before step {global_step}")
+            new_variant = health_step not in compiled_variants
+            compiled_variants.add(health_step)
+            # shield only post-arm first compiles: pre-arm compiles should
+            # still count toward the compile totals/time
+            suspend = (
+                tele.compile_watcher.suspended()
+                if (new_variant and tele is not None
+                    and tele.compile_watcher is not None
+                    and tele.compile_watcher.armed)
+                else _ctx.nullcontext()
+            )
+            with telemetry.span("dispatch"), suspend:
+                state, metrics = step_fn(
+                    state, device_batch, sk, with_health=health_step
+                )
+            if health_step:
+                # the one deliberate device->host sync of the diagnostics
+                # path: fetch the health pytree, name the leaves, publish
+                with telemetry.span("health_publish"):
+                    health_mod.publish_and_observe(
+                        metrics.pop("health"), health_paths, health_monitor,
+                        global_step, tele=tele, registry=obs_metrics.REGISTRY,
+                        echo=print if is_root else None,
+                    )
             if args.telemetry_sync and tele is not None:
                 # wait for THIS step's result: per-step wall-clock splits
                 # into data_wait / dispatch / block, the attribution the

@@ -6,6 +6,7 @@ annealing)."""
 from __future__ import annotations
 
 import argparse
+import functools
 import math
 import time
 
@@ -15,6 +16,8 @@ import optax
 
 from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches, prefetch_to_device
 from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.observability import health as health_pure
+from dalle_pytorch_tpu.observability import health_host as health_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
@@ -65,14 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
                         help="1 (default): block on each step's result so "
                              "per-step time splits into data_wait / dispatch "
                              "/ block; 0: never block")
+    parser.add_argument("--health_every", type=int, default=0, metavar="N",
+                        help="run the in-graph health diagnostic step every N "
+                             "steps (0 disables): per-layer grad/param/update "
+                             "norms, NaN/Inf localization, codebook usage/"
+                             "perplexity, gumbel-temperature tracking, and "
+                             "codebook-collapse alarms")
     return backend_mod.wrap_arg_parser(parser)
 
 
-def save_model(path: str, params, cfg: DiscreteVAEConfig):
+def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None):
     save_checkpoint(
         path,
         trees={"weights": to_host(params)},
-        meta={"hparams": cfg.to_dict(), "version": __version__},
+        meta={"hparams": cfg.to_dict(), "version": __version__,
+              "health_state": health_state},
     )
 
 
@@ -126,16 +136,29 @@ def main(argv=None):
             process_index=be.get_rank(),
         )
 
-    @jax.jit
-    def train_step(params, opt_state, images, key, temp, lr):
+    @functools.partial(jax.jit, static_argnames=("with_health",))
+    def train_step(params, opt_state, images, key, temp, lr, with_health=False):
         def loss_fn(p):
             return vae_mod.forward(p, cfg, images, key=key, return_loss=True, temp=temp)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = opt.update(grads, opt_state)
         updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        new_params = optax.apply_updates(params, updates)
+        health = None
+        if with_health:
+            # in-graph diagnostics (health-step executable only): per-leaf
+            # numerics + the dVAE-specific codebook health — usage below the
+            # monitor's floor is the gumbel-softmax collapse alarm
+            with jax.named_scope("health"):
+                health = health_pure.tree_health(params, grads, new_params)
+                health["loss_nonfinite"] = (~jnp.isfinite(loss)).astype(jnp.int32)
+                logits = vae_mod.encode_logits(params, cfg, images)
+                health.update(
+                    vae_mod.codebook_health_from_logits(logits, cfg.num_tokens)
+                )
+                health["gumbel_temp"] = jnp.asarray(temp, jnp.float32)
+        return new_params, opt_state, loss, health
 
     @jax.jit
     def codebook_indices(params, images):
@@ -153,12 +176,28 @@ def main(argv=None):
 
     denorm = lambda x: vae_mod.denormalize_images(cfg, x)  # noqa: E731
 
+    health_monitor = None
+    health_paths = None
+    if args.health_every:
+        health_paths = health_mod.leaf_paths(params)
+        health_monitor = health_mod.DivergenceMonitor(
+            on_alarm=health_mod.make_alarm_writer(tele, registry=obs_metrics.REGISTRY)
+        )
+        if is_root:
+            print(f"[health] diagnostics every {args.health_every} step(s); "
+                  "codebook usage/perplexity + per-layer numerics")
+
+    def _health_state():
+        return health_monitor.state_dict() if health_monitor is not None else None
+
     # fail fast on unwritable output before burning compute
     save_model(f"{args.vae_output_file_name}.pt", params, cfg)
 
     temp = args.starting_temp
     global_step = 0
     key = jax.random.PRNGKey(args.seed + 1)
+    compiled_variants = set()
+    import contextlib as _ctx
     for epoch in range(args.epochs):
         t0 = time.time()
         batches = iterate_image_batches(
@@ -179,14 +218,37 @@ def main(argv=None):
                     tele.abort_step()
                 break
             key, sk = jax.random.split(key)
-            with telemetry.span("dispatch"):
-                params, opt_state, loss = train_step(
-                    params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr)
+            health_step = bool(args.health_every) and (
+                global_step % args.health_every == 0
+            )
+            # first post-arm dispatch of a new executable variant (plain vs
+            # diagnostic) legitimately compiles — shield it from the
+            # steady-state recompile alarm
+            new_variant = health_step not in compiled_variants
+            compiled_variants.add(health_step)
+            suspend = (
+                tele.compile_watcher.suspended()
+                if (new_variant and tele is not None
+                    and tele.compile_watcher is not None
+                    and tele.compile_watcher.armed)
+                else _ctx.nullcontext()
+            )
+            with telemetry.span("dispatch"), suspend:
+                params, opt_state, loss, health = train_step(
+                    params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr),
+                    with_health=health_step,
                 )
             if tele is not None and args.telemetry_sync:
                 with telemetry.span("block"):
                     jax.block_until_ready(loss)
             obs_metrics.counter("train_steps").inc()
+            if health_step:
+                with telemetry.span("health_publish"):
+                    health_mod.publish_and_observe(
+                        health, health_paths, health_monitor, global_step,
+                        tele=tele, registry=obs_metrics.REGISTRY,
+                        echo=print if is_root else None,
+                    )
 
             if global_step % 100 == 0:
                 # temperature annealing (reference train_vae.py:276-278)
@@ -218,7 +280,8 @@ def main(argv=None):
             if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
                 t0 = time.perf_counter()
                 with telemetry.span("checkpoint"):
-                    save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+                    save_model(f"{args.vae_output_file_name}.pt", params, cfg,
+                               health_state=_health_state())
                 obs_metrics.histogram("checkpoint_save_s").observe(
                     time.perf_counter() - t0
                 )
@@ -228,7 +291,8 @@ def main(argv=None):
 
         lr *= args.lr_decay_rate
         if is_root:
-            save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+            save_model(f"{args.vae_output_file_name}.pt", params, cfg,
+                       health_state=_health_state())
             logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
 
     if tele is not None:

@@ -27,6 +27,8 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dalle_pytorch_tpu.observability import health as health_mod
+
 DEFAULT_BLOCK_Q = 256  # 256x256 tiles measured ~5% faster per train step than
 DEFAULT_BLOCK_K = 256  # 128x128 at seq 1280 on v5e (block shrinks to divide n)
 _LANES = 128  # TPU lane width; lse/delta rows are stored broadcast over lanes
@@ -213,11 +215,18 @@ def _flash_fwd(q, k, v, mask, live, kmask, h, causal, scale, block_q, block_k):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
+            # static python floats from shapes — host-sync-ok
             flops=int(flops), bytes_accessed=int(3 * bh * n * d * 4),
             transcendentals=int(bh * n * n),
         ),
         interpret=_interpret(),
     )(q, k, v, *margs, *kargs)
+    if health_mod.taps_active():
+        # the fused kernel never materializes scores; its logsumexp rows are
+        # the exported logit statistic (row max <= lse <= row max + log n) —
+        # the saturation signal for bf16 attention numerics without giving
+        # up the O(n)-memory path
+        health_mod.tap_attention("attn_flash", lse=lse[:, :, 0])
     return out, lse
 
 
@@ -492,7 +501,7 @@ def flash_attention(
 
     if mask is not None and live is None:
         try:  # static masks (the normal case) yield a tile-liveness table
-            mask_np = np.asarray(mask)
+            mask_np = np.asarray(mask)  # host-sync-ok: traced masks raise into the except
             if mask_np.ndim == 3:  # per-head (h, n, n)
                 live = jnp.asarray(
                     mask_np.reshape(mask_np.shape[0], n // block_q, block_q,

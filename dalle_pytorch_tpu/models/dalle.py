@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from dalle_pytorch_tpu.core.module import embedding_init, layer_norm, layer_norm_init, linear, linear_init
 from dalle_pytorch_tpu.core.rng import KeyChain
 from dalle_pytorch_tpu.models.transformer import TransformerConfig, apply_transformer, init_transformer
+from dalle_pytorch_tpu.observability import health as health_mod
 from dalle_pytorch_tpu.ops.sampling import prob_mask_like
 from dalle_pytorch_tpu.ops.stable import divide_max
 
@@ -311,6 +312,19 @@ def forward(
     logits = jnp.where(
         logits_mask_slice(cfg, n)[None], jnp.finfo(logits.dtype).min, logits
     )
+
+    if health_mod.taps_active():
+        # output-head numerics for the diagnostic probe: vocab-logit max and
+        # mean predictive entropy (H = lse - E_p[logit]; the masked fills
+        # carry zero probability, so the streamed identity stays exact)
+        lg32 = logits.astype(jnp.float32)
+        lse_h = jax.scipy.special.logsumexp(lg32, axis=-1)
+        ent_h = lse_h - jnp.sum(jax.nn.softmax(lg32, axis=-1) * lg32, axis=-1)
+        health_mod.tap(
+            "dalle_logits",
+            logit_max=jnp.max(lg32),
+            entropy_mean=jnp.mean(ent_h),
+        )
 
     if not return_loss:
         return logits

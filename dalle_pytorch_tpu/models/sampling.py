@@ -14,6 +14,7 @@ compiles); the reference's 0.4375 * image_seq_len default is preserved.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -21,6 +22,8 @@ import jax
 import jax.numpy as jnp
 
 from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.transformer import apply_transformer, decode_step, init_cache, prefill
 from dalle_pytorch_tpu.ops.sampling import gumbel_sample, top_k_filter
@@ -47,29 +50,20 @@ def _cfg_combine(logits: jnp.ndarray, cond_scale: float) -> jnp.ndarray:
     return null + (cond - null) * cond_scale
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "filter_thres", "cond_scale", "prime_len"),
-)
-def sample_image_codes(
+def _prefill_phase(
     params: dict,
     cfg: DALLEConfig,
     text: jnp.ndarray,
-    key: jax.Array,
-    filter_thres: float = 0.5,
-    temperature: float = 1.0,
-    cond_scale: float = 1.0,
-    primer_codes: Optional[jnp.ndarray] = None,
-    prime_len: int = 0,
-    noise_override: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """text: (b, text_seq_len) raw token ids (0 = pad).  primer_codes:
-    optional (b, prime_len) VAE codes to prime the image with.
-    noise_override: optional (n_gen, b, total_tokens) pre-generated gumbel
-    noise consumed instead of key-derived noise — the parity-RNG mode for
-    bit-exact comparison against other implementations (SURVEY.md §7 hard
-    part #1).  Returns (b, image_seq_len) image codes (primer included)."""
-    b = text.shape[0]
+    primer_codes: Optional[jnp.ndarray],
+    prime_len: int,
+    cond_scale: float,
+):
+    """Everything before the first sampled token: CFG batch doubling, bos +
+    text (+ primer) embedding, KV-cache prefill, and the logits for the
+    first generated position.  Returns (cache, last_logits).  Split out so
+    telemetry-enabled callers can dispatch prefill and decode as separate
+    jits and attribute wall-clock per phase; `sample_image_codes` fuses both
+    phases into one jit (the graph is identical either way)."""
     tcfg = cfg.transformer_config()
     guided = cond_scale != 1.0
 
@@ -92,7 +86,30 @@ def sample_image_codes(
     cache = init_cache(tcfg, bb, dtype=params["logits_linear"]["w"].dtype)
     out, cache = prefill(params["transformer"], tcfg, tokens, cache)
     last_logits = _logits_at(params, cfg, out[:, -1:], n_pre - 1)
+    return cache, last_logits
 
+
+def _decode_phase(
+    params: dict,
+    cfg: DALLEConfig,
+    cache,
+    last_logits: jnp.ndarray,
+    key: jax.Array,
+    filter_thres: float,
+    temperature,
+    cond_scale: float,
+    primer_codes: Optional[jnp.ndarray],
+    prime_len: int,
+    noise_override: Optional[jnp.ndarray],
+    collect_stats: bool = False,
+):
+    """The autoregressive image loop from a prefilled cache.  `primer_codes`
+    is the ORIGINAL (un-doubled) primer.  With collect_stats=True also
+    returns {"logit_max", "entropy_mean"} over the (guided, top-k-filtered)
+    sampling distributions — the sampling-time logit numerics."""
+    guided = cond_scale != 1.0
+    b = last_logits.shape[0] // 2 if guided else last_logits.shape[0]
+    tcfg = cfg.transformer_config()
     n_gen = cfg.image_seq_len - prime_len
     assert n_gen > 0, "primer must be shorter than the image sequence"
 
@@ -105,10 +122,19 @@ def sample_image_codes(
         else:
             tok = gumbel_sample(k, filtered, temperature=temperature)
         code = jnp.clip(tok - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1)
-        return code
+        if not collect_stats:
+            return code, None
+        f32 = filtered.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(f32, axis=-1)
+        p = jax.nn.softmax(f32, axis=-1)
+        # filtered entries are -inf with p == 0: mask before multiplying
+        # (0 * -inf is NaN, not the 0 the entropy identity needs)
+        plog = jnp.where(jnp.isfinite(f32), p * f32, 0.0)
+        ent = lse - jnp.sum(plog, axis=-1)
+        return code, {"logit_max": jnp.max(f32), "entropy_mean": jnp.mean(ent)}
 
     key, k0 = jax.random.split(key)
-    first_code = sample_token(
+    first_code, first_stats = sample_token(
         last_logits, k0, noise_override[0] if noise_override is not None else None
     )
 
@@ -124,22 +150,86 @@ def sample_image_codes(
         x = dalle_mod.embed_image_codes(params, cfg, feed[:, None], start=img_pos)
         out, cache = decode_step(params["transformer"], tcfg, x, cache)
         logits = _logits_at(params, cfg, out, cache["offset"] - 1)
-        code = sample_token(logits, step_key, noise)
-        return (cache, code, img_pos + 1), code
+        code, stats = sample_token(logits, step_key, noise)
+        ys = (code, stats) if collect_stats else code
+        return (cache, code, img_pos + 1), ys
 
     init = (cache, first_code, jnp.asarray(prime_len, jnp.int32))
+    step_stats = None
     if n_gen > 1:
         xs = step_keys[: n_gen - 1]
         if noise_override is not None:
             xs = (xs, noise_override[1:n_gen])
         (_, _, _), rest = jax.lax.scan(body, init, xs)
+        if collect_stats:
+            rest, step_stats = rest
         codes = jnp.concatenate([first_code[None], rest], axis=0).T  # (b, n_gen)
     else:
         codes = first_code[:, None]
 
     if prime_len > 0:
         codes = jnp.concatenate([primer_codes[:b], codes], axis=1)
-    return codes
+    if not collect_stats:
+        return codes
+    if step_stats is not None:
+        logit_max = jnp.maximum(first_stats["logit_max"],
+                                jnp.max(step_stats["logit_max"]))
+        entropy_mean = (
+            first_stats["entropy_mean"] + jnp.sum(step_stats["entropy_mean"])
+        ) / n_gen
+    else:
+        logit_max = first_stats["logit_max"]
+        entropy_mean = first_stats["entropy_mean"]
+    return codes, {"logit_max": logit_max, "entropy_mean": entropy_mean}
+
+
+# jitted per-phase variants for the telemetry path (generate_images): two
+# dispatches with a block between them is what turns "sampling is slow" into
+# "prefill-bound vs decode-bound"
+_prefill_jit = partial(
+    jax.jit, static_argnames=("cfg", "cond_scale", "prime_len")
+)(_prefill_phase)
+_decode_jit = partial(
+    jax.jit,
+    static_argnames=("cfg", "filter_thres", "cond_scale", "prime_len",
+                     "collect_stats"),
+)(_decode_phase)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "filter_thres", "cond_scale", "prime_len",
+                     "return_logit_stats"),
+)
+def sample_image_codes(
+    params: dict,
+    cfg: DALLEConfig,
+    text: jnp.ndarray,
+    key: jax.Array,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    cond_scale: float = 1.0,
+    primer_codes: Optional[jnp.ndarray] = None,
+    prime_len: int = 0,
+    noise_override: Optional[jnp.ndarray] = None,
+    return_logit_stats: bool = False,
+) -> jnp.ndarray:
+    """text: (b, text_seq_len) raw token ids (0 = pad).  primer_codes:
+    optional (b, prime_len) VAE codes to prime the image with.
+    noise_override: optional (n_gen, b, total_tokens) pre-generated gumbel
+    noise consumed instead of key-derived noise — the parity-RNG mode for
+    bit-exact comparison against other implementations (SURVEY.md §7 hard
+    part #1).  Returns (b, image_seq_len) image codes (primer included);
+    with return_logit_stats=True returns (codes, {"logit_max",
+    "entropy_mean"}) — sampling-distribution numerics for health telemetry."""
+    cache, last_logits = _prefill_phase(
+        params, cfg, text, primer_codes, prime_len, cond_scale
+    )
+    return _decode_phase(
+        params, cfg, cache, last_logits, key, filter_thres, temperature,
+        cond_scale, primer_codes, prime_len, noise_override,
+        collect_stats=return_logit_stats,
+    )
 
 
 def generate_images(
@@ -160,7 +250,14 @@ def generate_images(
     """Full pipeline: sample codes, decode through the VAE (any family —
     DiscreteVAE / VQGAN / OpenAI dVAE, dispatched on the config type),
     optionally score with CLIP.  img: optional (b, H, W, C) raw pixels for
-    priming."""
+    priming.
+
+    With telemetry active, inference-side metrics land in the registry:
+    prefill vs decode wall-clock (dispatched as two jits with a block in
+    between — same graph, so parity with the fused path is exact),
+    image-tokens/sec, VAE decode time, sampling-logit numerics, and a CFG
+    overhead counter when cond_scale != 1 (guidance doubles every network
+    evaluation)."""
     from dalle_pytorch_tpu.models import clip as clip_mod
     from dalle_pytorch_tpu.models import vae_registry
 
@@ -177,12 +274,64 @@ def generate_images(
         assert prime_len < cfg.image_seq_len
         primer = indices[:, :prime_len]
 
-    codes = sample_image_codes(
-        params, cfg, text, key,
-        filter_thres=filter_thres, temperature=temperature, cond_scale=cond_scale,
-        primer_codes=primer, prime_len=prime_len,
-    )
+    b = int(text.shape[0])
+    n_gen = cfg.image_seq_len - prime_len
+    tele = telemetry.active()
+    if tele is None:
+        codes = sample_image_codes(
+            params, cfg, text, key,
+            filter_thres=filter_thres, temperature=temperature, cond_scale=cond_scale,
+            primer_codes=primer, prime_len=prime_len,
+        )
+    else:
+        import contextlib
+
+        # sampling compiles are expected per shape and are not step-loop
+        # thrash — shield them from the steady-state recompile alarm
+        suspend = (tele.compile_watcher.suspended()
+                   if tele.compile_watcher is not None
+                   else contextlib.nullcontext())
+        with suspend:
+            with telemetry.span("gen_prefill"):
+                t0 = time.perf_counter()
+                cache, last_logits = _prefill_jit(
+                    params, cfg, text, primer, prime_len, cond_scale
+                )
+                jax.block_until_ready(last_logits)
+                prefill_s = time.perf_counter() - t0
+            with telemetry.span("gen_decode"):
+                t0 = time.perf_counter()
+                codes, lstats = _decode_jit(
+                    params, cfg, cache, last_logits, key, filter_thres, temperature,
+                    cond_scale, primer, prime_len, None, collect_stats=True,
+                )
+                jax.block_until_ready(codes)
+                decode_s = time.perf_counter() - t0
+        obs_metrics.histogram("gen/prefill_s").observe(prefill_s)
+        obs_metrics.histogram("gen/decode_s").observe(decode_s)
+        obs_metrics.counter("gen/images").inc(b)
+        obs_metrics.counter("gen/image_tokens").inc(b * n_gen)
+        obs_metrics.gauge("gen/image_tokens_per_sec").set(
+            b * n_gen / max(decode_s, 1e-9)
+        )
+        import numpy as np
+
+        obs_metrics.gauge("gen/logit_max").set(float(np.asarray(lstats["logit_max"])))
+        obs_metrics.gauge("gen/logit_entropy_mean").set(
+            float(np.asarray(lstats["entropy_mean"]))
+        )
+        if cond_scale != 1.0:
+            # every prefill token and every decode step runs twice ([cond;
+            # null]); this counter is the guidance bill in token evaluations
+            obs_metrics.counter("gen/cfg_extra_token_evals").inc(
+                b * (cfg.text_seq_len + 1 + cfg.image_seq_len)
+            )
+
+    t0 = time.perf_counter()
     images = vae_registry.decode_indices(vae_params, vae_cfg, codes)
+    if telemetry.active() is not None:
+        jax.block_until_ready(images)
+        obs_metrics.histogram("gen/vae_decode_s").observe(time.perf_counter() - t0)
 
     if clip_params is not None:
         scores = clip_mod.forward(clip_params, clip_cfg, text, images)

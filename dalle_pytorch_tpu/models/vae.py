@@ -28,6 +28,7 @@ from dalle_pytorch_tpu.core.module import (
     conv2d_transpose_init,
 )
 from dalle_pytorch_tpu.core.rng import KeyChain
+from dalle_pytorch_tpu.observability import health as health_mod
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,10 +162,46 @@ def decode_embeddings(params: dict, cfg: DiscreteVAEConfig, z: jnp.ndarray) -> j
     return conv2d(params["dec_out"], x, padding=0)
 
 
+def codebook_health_from_logits(logits: jnp.ndarray, num_tokens: int) -> dict:
+    """In-graph dVAE codebook-health stats from encoder logits
+    (..., num_tokens).  Pure (jit-safe, no host sync):
+
+    * `code_hist` — hard (argmax) assignment counts per codebook entry;
+    * `codebook_usage` — fraction of entries selected at least once in the
+      batch;
+    * `codebook_perplexity` — exp(entropy of the mean soft assignment): the
+      effective number of codes in use.  Gumbel-softmax codebook collapse
+      (the classic DALL-E dVAE failure) shows up as perplexity → 1 while the
+      reconstruction loss still looks plausible;
+    * `codebook_entropy` — mean per-cell assignment entropy (sharpness of
+      individual assignments, distinct from diversity across cells)."""
+    flat = logits.reshape(-1, num_tokens).astype(jnp.float32)
+    idx = jnp.argmax(flat, axis=-1)
+    hist = jnp.bincount(idx, length=num_tokens)
+    p = jax.nn.softmax(flat, axis=-1)
+    p_mean = jnp.mean(p, axis=0)
+    return {
+        "code_hist": hist,
+        "codebook_usage": jnp.mean((hist > 0).astype(jnp.float32)),
+        "codebook_perplexity": jnp.exp(-jnp.sum(p_mean * jnp.log(p_mean + 1e-20))),
+        "codebook_entropy": jnp.mean(-jnp.sum(p * jnp.log(p + 1e-20), axis=-1)),
+    }
+
+
 def get_codebook_indices(params: dict, cfg: DiscreteVAEConfig, images: jnp.ndarray) -> jnp.ndarray:
     """(B, H, W, C) raw pixels -> (B, image_seq_len) hard code indices."""
     logits = encode_logits(params, cfg, images)
     b = logits.shape[0]
+    if health_mod.taps_active():
+        # DALL-E training tokenizes through the frozen dVAE right here — the
+        # diagnostic probe gets codebook usage/perplexity of the batch free
+        h = codebook_health_from_logits(logits, cfg.num_tokens)
+        health_mod.tap(
+            "dvae_codebook",
+            usage=h["codebook_usage"],
+            perplexity=h["codebook_perplexity"],
+            entropy=h["codebook_entropy"],
+        )
     return jnp.argmax(logits, axis=-1).reshape(b, -1)
 
 

@@ -9,6 +9,15 @@ Instrumented code imports the cheap module-level helpers:
 which are no-ops / registry updates until a CLI calls
 `telemetry.configure(dir=...)`.  See tools/telemetry_report.py for turning a
 run's spans JSONL into a per-step time-attribution table."""
+from dalle_pytorch_tpu.observability.health import (
+    capture_taps,
+    leaf_paths,
+    tap,
+    tap_attention,
+    taps_active,
+    tree_health,
+)
+from dalle_pytorch_tpu.observability.health_host import DivergenceMonitor
 from dalle_pytorch_tpu.observability.heartbeat import Heartbeat, thread_stacks
 from dalle_pytorch_tpu.observability.metrics import (
     REGISTRY,
@@ -35,19 +44,26 @@ from dalle_pytorch_tpu.observability.xla import (
 __all__ = [
     "REGISTRY",
     "CompileWatcher",
+    "DivergenceMonitor",
     "FlopsCrosscheck",
     "Heartbeat",
     "MetricsRegistry",
     "SpanRecorder",
     "Telemetry",
     "active",
+    "capture_taps",
     "configure",
     "counter",
     "device_memory_stats",
     "gauge",
     "histogram",
+    "leaf_paths",
     "record_memory_gauges",
     "span",
     "step_cost_analysis",
+    "tap",
+    "tap_attention",
+    "taps_active",
     "thread_stacks",
+    "tree_health",
 ]

@@ -1,0 +1,254 @@
+"""In-graph training-health diagnostics.
+
+PR 1's telemetry answers "where does the time go"; this module answers
+"where does the training go wrong": per-layer gradient/parameter/update
+norms, NaN/Inf localization, activation statistics captured from inside the
+model (attention logits, dVAE codebook usage), and host-side divergence
+alarms with state that survives checkpoint restarts.
+
+Design split — two strictly separated halves:
+
+* **In-graph half** (`tree_health`, `per_leaf_norms`, `nonfinite_counts`,
+  the tap machinery): pure jax functions traced INSIDE the jitted train
+  step.  They never synchronize with the host — no `.item()`, `float()`,
+  `np.asarray`, or `jax.device_get` (enforced by `tools/lint_host_sync.py`).
+  The train step exposes them behind a static `with_health` argument, so the
+  health-off executable's HLO is byte-identical to a build without any of
+  this code: diagnostics are a SECOND compiled executable the training loop
+  dispatches every `--health_every` steps, not a tax on every step.
+
+* **Host half** (`leaf_paths`, `first_nonfinite`, `publish`,
+  `DivergenceMonitor`): consumes the health pytree after the training loop
+  fetched it (the one deliberate device→host sync, paid only on health
+  steps), converts per-leaf vectors back into path-named records, feeds the
+  metrics registry, and raises threshold alarms through the telemetry event
+  stream (`kind: "alarm"` — same path recompile/FLOPs alarms use).
+
+The per-leaf vectors are ordered by `jax.tree_util.tree_flatten_with_path`
+over the parameter pytree; `leaf_paths(params)` gives the matching names.
+For `--scan_layers` configs a stacked leaf carries all depth layers in one
+array, so "per layer" degrades to "per stacked parameter" there (localizing
+inside a scanned stack would need a per-slice reduction; not done yet).
+
+Activation taps
+---------------
+
+Model code exports intermediate statistics through a trace-time capture
+context:
+
+    with health.capture_taps() as taps:
+        loss = loss_fn(params, batch, key)   # attend()/flash/etc call tap()
+    # taps: {name: {stat: traced f32 scalar}} — merge into the step outputs
+
+`tap()` is a no-op (zero added HLO) unless a capture context is active on
+the current thread.  Taps must only fire in a plain forward — recording
+tracers from inside `jax.grad`'s trace would leak them — so the diagnostic
+step runs one extra probe forward (first microbatch) under the capture
+context rather than tapping the differentiated forward.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# activation taps (trace-time capture of model intermediates)
+# ---------------------------------------------------------------------------
+
+class _TapState(threading.local):
+    def __init__(self):
+        self.sink: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None
+        self.trace = None  # the jax trace active when capture started
+        self.skipped = 0
+
+
+_TAP = _TapState()
+
+
+def _cur_trace():
+    """The currently-active jax trace object (identity is the token: scan /
+    checkpoint / inner-jit bodies trace under a DIFFERENT object than the
+    enclosing trace).  None when this jax version has no trace_ctx — the
+    guard then degrades to 'record everything' (pre-stackless-tracing jax
+    raised on the leak anyway, so nothing is lost)."""
+    try:
+        return jax.core.trace_ctx.trace
+    except AttributeError:  # pragma: no cover - jax < 0.4.34
+        return None
+
+
+def taps_active() -> bool:
+    """True iff a `capture_taps()` context is active on this thread.
+    Instrumented code guards stat computation on this, so the health-off
+    trace contains zero extra ops."""
+    return _TAP.sink is not None
+
+
+@contextlib.contextmanager
+def capture_taps():
+    """Collect `tap()` records emitted while tracing the enclosed block.
+    Yields the sink dict: {name: {stat_name: scalar}}.  Values are traced
+    arrays belonging to the enclosing trace — consume them there (e.g. merge
+    into the step's output pytree); do not stash them past the trace.
+
+    Taps fired from INSIDE a nested trace — a `lax.scan` body
+    (`--scan_layers`), a `jax.checkpoint` region (`--execution remat`), a
+    nested jit — are DROPPED, not recorded: their tracers cannot legally
+    escape into this context's trace, and recording them would crash the
+    diagnostic step with UnexpectedTracerError at its first use on exactly
+    the remat/scan flagship configs.  `taps_skipped()` reports how many were
+    dropped; top-level taps (output logits, dVAE codebook) always survive."""
+    prev, prev_trace, prev_skipped = _TAP.sink, _TAP.trace, _TAP.skipped
+    _TAP.sink = sink = {}
+    _TAP.trace = _cur_trace()
+    _TAP.skipped = 0
+    try:
+        yield sink
+    finally:
+        _TAP.sink = prev
+        _TAP.trace = prev_trace
+        # keep the skip count readable after exit (reset on next capture)
+        if prev is not None:
+            _TAP.skipped = prev_skipped
+
+
+def taps_skipped() -> int:
+    """Taps dropped by the most recent capture because they fired inside a
+    nested trace (scan/remat/inner-jit bodies)."""
+    return _TAP.skipped
+
+
+def tap(name: str, **stats) -> None:
+    """Record named scalar statistics into the active capture (no-op when
+    none).  Repeated names get a numeric suffix (layer 2's attention tap
+    lands beside layer 1's, not on top of it).  Calls from inside a nested
+    trace are dropped — see capture_taps()."""
+    sink = _TAP.sink
+    if sink is None:
+        return
+    if _TAP.trace is not None and _cur_trace() is not _TAP.trace:
+        _TAP.skipped += 1
+        return
+    base, i = name, 1
+    while name in sink:
+        i += 1
+        name = f"{base}_{i}"
+    sink[name] = {k: jnp.asarray(v, jnp.float32) for k, v in stats.items()}
+
+
+def tap_attention(name: str, scores: Optional[jnp.ndarray] = None,
+                  probs: Optional[jnp.ndarray] = None,
+                  lse: Optional[jnp.ndarray] = None) -> None:
+    """Attention-numerics tap from whatever intermediate the implementation
+    has on hand.  Dense attention passes `scores` (pre-softmax logits, f32)
+    and `probs` (exact max-logit + row-entropy); the flash kernel only
+    exports its logsumexp rows, so the fused path passes `lse` — lse bounds
+    the row max (max ≤ lse ≤ max + log n) and is the saturation signal the
+    bf16 overflow hunt needs."""
+    if not taps_active():
+        return
+    stats: Dict[str, jnp.ndarray] = {}
+    if scores is not None:
+        s32 = scores.astype(jnp.float32)
+        stats["logit_max"] = jnp.max(s32)
+        # mean of per-row maxes, not the raw mean — masked positions carry
+        # finfo.min fills that would swamp a plain mean (every causal row
+        # has at least its diagonal live)
+        stats["logit_rowmax_mean"] = jnp.mean(jnp.max(s32, axis=-1))
+    if probs is not None:
+        p32 = probs.astype(jnp.float32)
+        ent = -jnp.sum(p32 * jnp.log(p32 + 1e-20), axis=-1)
+        stats["entropy_mean"] = jnp.mean(ent)
+        stats["entropy_min"] = jnp.min(ent)
+    if lse is not None:
+        l32 = lse.astype(jnp.float32)
+        stats["lse_max"] = jnp.max(l32)
+        stats["lse_mean"] = jnp.mean(l32)
+    if stats:
+        tap(name, **stats)
+
+
+# ---------------------------------------------------------------------------
+# in-graph numerics (pure; called inside the jitted step)
+# ---------------------------------------------------------------------------
+
+def per_leaf_norms(tree: Any) -> jnp.ndarray:
+    """(n_leaves,) f32 L2 norm of every leaf, flatten order.  Per-leaf fused
+    reductions — no f32 copy of the tree is materialized."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.stack([
+        jnp.sqrt(jnp.sum(jnp.square(l.astype(jnp.float32)))) for l in leaves
+    ])
+
+
+def nonfinite_counts(tree: Any) -> jnp.ndarray:
+    """(n_leaves,) int32 count of non-finite elements per leaf."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.stack([
+        jnp.sum(~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.int32)
+        for l in leaves
+    ])
+
+
+def tree_health(params: Any, grads: Any, new_params: Any) -> Dict[str, jnp.ndarray]:
+    """The core per-layer numerics pytree, computed in-graph.
+
+    grads are whatever the optimizer is about to consume (post-unscale,
+    post-clip when clipping is on — the APPLIED gradients).  The update is
+    measured as `new_params - params` in f32, which captures the REALIZED
+    update — including stochastic-rounding loss under bf16 param storage and
+    the all-zero update of a loss-scale skip step.
+
+    `param_nonfinite` is computed on the INPUT params, not the updated ones:
+    once a single poisoned weight has driven the loss NaN, the post-update
+    params are non-finite EVERYWHERE (NaN grads reach every leaf through the
+    optimizer) — the pre-step params are the tree that still localizes the
+    original offender."""
+    grad_norm = per_leaf_norms(grads)
+    param_norm = per_leaf_norms(params)
+    upd = jax.tree_util.tree_map(
+        lambda new, old: new.astype(jnp.float32) - old.astype(jnp.float32),
+        new_params, params,
+    )
+    update_norm = per_leaf_norms(upd)
+    return {
+        "grad_norm": grad_norm,
+        "param_norm": param_norm,
+        "update_norm": update_norm,
+        "update_ratio": update_norm / (param_norm + _EPS),
+        "grad_nonfinite": nonfinite_counts(grads),
+        "param_nonfinite": nonfinite_counts(params),
+        "grad_norm_global": jnp.sqrt(jnp.sum(jnp.square(grad_norm))),
+    }
+
+
+# ---------------------------------------------------------------------------
+# leaf naming (trace-time/static — no device sync)
+# ---------------------------------------------------------------------------
+
+def _path_str(path) -> str:
+    ju = jax.tree_util
+    segs = []
+    for p in path:
+        if isinstance(p, ju.DictKey):
+            segs.append(str(p.key))
+        elif isinstance(p, ju.SequenceKey):
+            segs.append(str(p.idx))
+        elif isinstance(p, ju.GetAttrKey):
+            segs.append(p.name)
+        else:
+            segs.append(str(p))
+    return "/".join(segs)
+
+
+def leaf_paths(tree: Any) -> List[str]:
+    """Path name per leaf, in the flatten order the per-leaf vectors use."""
+    with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [_path_str(p) for p, _ in with_path]

@@ -1,0 +1,283 @@
+"""Host half of the training-health diagnostics.
+
+Consumes the health pytree AFTER the training loop fetched it from the
+device: names the per-leaf vectors (`publish`), reduces the finite masks to
+the first offending path (`first_nonfinite`), raises threshold-based
+divergence alarms whose state survives checkpoint restarts
+(`DivergenceMonitor`), and provides the NaN-injection test hook.
+
+This module deliberately host-syncs (np.asarray / float / int on device
+values) — that is its job.  It lives OUTSIDE the jit-pure module set that
+`tools/lint_host_sync.py` enforces; the in-graph half is
+observability/health.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.observability.health import _EPS, _path_str, leaf_paths
+
+__all__ = [
+    "DivergenceMonitor",
+    "first_nonfinite",
+    "inject_nan",
+    "leaf_paths",
+    "make_alarm_writer",
+    "publish",
+    "publish_and_observe",
+]
+
+
+def first_nonfinite(paths: List[str], counts) -> Optional[str]:
+    """First offending path name from a per-leaf nonfinite-count vector
+    (host-side reduction of the in-graph finite mask); None when clean."""
+    for path, c in zip(paths, counts):
+        if int(c) > 0:
+            return path
+    return None
+
+
+def publish(health: Dict[str, Any], paths: List[str],
+            registry=None) -> Dict[str, Any]:
+    """Convert a fetched health pytree into a JSON-ready record (the one
+    deliberate device→host sync of the diagnostics path — call this from the
+    training loop, never from jit-pure code) and mirror the headline scalars
+    into the metrics registry when given."""
+    import numpy as np
+
+    def _f(x):
+        return float(np.asarray(x))
+
+    rec: Dict[str, Any] = {}
+    per_leaf = {}
+    for k in ("grad_norm", "param_norm", "update_norm", "update_ratio"):
+        if k in health:
+            per_leaf[k] = np.asarray(health[k], dtype=np.float64)
+    gnf = np.asarray(health["grad_nonfinite"]) if "grad_nonfinite" in health else None
+    pnf = np.asarray(health["param_nonfinite"]) if "param_nonfinite" in health else None
+    if per_leaf:
+        layers = []
+        n = len(paths)
+        for i in range(n):
+            row = {"path": paths[i]}
+            for k, v in per_leaf.items():
+                row[k] = round(float(v[i]), 8)
+            if gnf is not None:
+                row["grad_nonfinite"] = int(gnf[i])
+            if pnf is not None:
+                row["param_nonfinite"] = int(pnf[i])
+            layers.append(row)
+        rec["layers"] = layers
+    if "grad_norm_global" in health:
+        rec["grad_norm_global"] = _f(health["grad_norm_global"])
+    if "loss_nonfinite" in health:
+        rec["loss_nonfinite"] = int(np.asarray(health["loss_nonfinite"]))
+    if "taps_dropped_inner_trace" in health:
+        rec["taps_dropped_inner_trace"] = int(
+            np.asarray(health["taps_dropped_inner_trace"])
+        )
+    if "probe_loss" in health:
+        rec["probe_loss"] = _f(health["probe_loss"])
+    # nonfinite localization: params first (a poisoned weight makes every
+    # grad in the model NaN through the loss — the weight is the cause)
+    nf = None
+    if pnf is not None:
+        nf = first_nonfinite(paths, pnf)
+        if nf is not None:
+            rec["first_nonfinite_kind"] = "params"
+    if nf is None and gnf is not None:
+        nf = first_nonfinite(paths, gnf)
+        if nf is not None:
+            rec["first_nonfinite_kind"] = "grads"
+    rec["first_nonfinite"] = nf
+    if "taps" in health and health["taps"]:
+        rec["taps"] = {
+            name: {k: round(_f(v), 6) for k, v in stats.items()}
+            for name, stats in health["taps"].items()
+        }
+    # model-specific extras (dVAE codebook, gumbel temp) pass through by name
+    for k in ("codebook_usage", "codebook_perplexity", "codebook_entropy",
+              "gumbel_temp"):
+        if k in health:
+            rec[k] = _f(health[k])
+    if "code_hist" in health:
+        hist = np.asarray(health["code_hist"])
+        rec["code_hist_nonzero"] = int((hist > 0).sum())
+        rec["code_hist_total"] = int(hist.sum())
+        rec["code_hist_max_frac"] = (
+            round(float(hist.max()) / max(float(hist.sum()), 1.0), 6)
+        )
+    if registry is not None:
+        if "grad_norm_global" in rec:
+            registry.gauge("health/grad_norm_global").set(rec["grad_norm_global"])
+        if per_leaf.get("update_ratio") is not None and len(per_leaf["update_ratio"]):
+            registry.gauge("health/update_ratio_max").set(
+                float(per_leaf["update_ratio"].max())
+            )
+        nonfinite_leaves = 0
+        for v in (gnf, pnf):
+            if v is not None:
+                nonfinite_leaves += int((v > 0).sum())
+        registry.gauge("health/nonfinite_leaves").set(nonfinite_leaves)
+        for k in ("codebook_usage", "codebook_perplexity", "gumbel_temp"):
+            if k in rec:
+                registry.gauge(f"health/{k}").set(rec[k])
+    return rec
+
+
+class DivergenceMonitor:
+    """Threshold alarms over the per-health-step records, with state that
+    round-trips through checkpoint metadata so a restart keeps the EMA and
+    the divergence onset instead of re-arming from scratch.
+
+    Alarms (each fired through `on_alarm(dict)` and returned):
+      * grad_spike      — global grad-norm > spike_factor × its EMA (after a
+                          warmup of observed steps)
+      * nonfinite       — any non-finite param/grad leaf; record carries the
+                          first offending path
+      * sustained_nonfinite — nonfinite_patience consecutive health steps
+                          with non-finite leaves (the "it is not recovering"
+                          escalation)
+      * codebook_collapse — dVAE codebook usage below usage_floor
+    """
+
+    def __init__(self, ema_decay: float = 0.9, spike_factor: float = 10.0,
+                 warmup: int = 3, nonfinite_patience: int = 2,
+                 usage_floor: float = 0.02, on_alarm=None):
+        self.ema_decay = float(ema_decay)
+        self.spike_factor = float(spike_factor)
+        self.warmup = int(warmup)
+        self.nonfinite_patience = int(nonfinite_patience)
+        self.usage_floor = float(usage_floor)
+        self.on_alarm = on_alarm
+        self._ema: Optional[float] = None
+        self._seen = 0
+        self._nonfinite_streak = 0
+        self.diverged_at: Optional[int] = None
+
+    # -- persistence --------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "ema": self._ema,
+            "seen": self._seen,
+            "nonfinite_streak": self._nonfinite_streak,
+            "diverged_at": self.diverged_at,
+        }
+
+    def load_state_dict(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        self._ema = None if state.get("ema") is None else float(state["ema"])
+        self._seen = int(state.get("seen", 0))
+        self._nonfinite_streak = int(state.get("nonfinite_streak", 0))
+        self.diverged_at = state.get("diverged_at")
+
+    # -- observation --------------------------------------------------------
+    def _alarm(self, step: int, kind: str, **fields) -> Dict[str, Any]:
+        alarm = {"type": kind, "step": step, **fields}
+        if self.diverged_at is None:
+            self.diverged_at = step
+            alarm["divergence_began"] = True
+        if self.on_alarm is not None:
+            self.on_alarm(alarm)
+        return alarm
+
+    def observe(self, step: int, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Feed one `publish()` record; returns the alarms it raised."""
+        import math
+
+        alarms: List[Dict[str, Any]] = []
+        nf = rec.get("first_nonfinite")
+        if nf is not None or rec.get("loss_nonfinite"):
+            self._nonfinite_streak += 1
+            alarms.append(self._alarm(
+                step, "nonfinite",
+                path=nf, leaf_kind=rec.get("first_nonfinite_kind"),
+                loss_nonfinite=bool(rec.get("loss_nonfinite")),
+            ))
+            if self._nonfinite_streak == self.nonfinite_patience:
+                alarms.append(self._alarm(
+                    step, "sustained_nonfinite",
+                    streak=self._nonfinite_streak, path=nf,
+                ))
+        else:
+            self._nonfinite_streak = 0
+
+        g = rec.get("grad_norm_global")
+        if g is not None and math.isfinite(g):
+            if (self._seen >= self.warmup and self._ema is not None
+                    and g > self.spike_factor * max(self._ema, _EPS)):
+                alarms.append(self._alarm(
+                    step, "grad_spike", grad_norm=g,
+                    ema=round(self._ema, 8), factor=round(g / max(self._ema, _EPS), 2),
+                ))
+            self._ema = g if self._ema is None else (
+                self.ema_decay * self._ema + (1.0 - self.ema_decay) * g
+            )
+            self._seen += 1
+
+        usage = rec.get("codebook_usage")
+        if usage is not None and usage < self.usage_floor:
+            alarms.append(self._alarm(
+                step, "codebook_collapse",
+                usage=round(usage, 6), floor=self.usage_floor,
+            ))
+        return alarms
+
+
+def inject_nan(tree: Any, pattern: str) -> Any:
+    """Test hook: return a copy of `tree` with the first element of the first
+    floating leaf whose path contains `pattern` replaced by NaN (used by the
+    `--health_inject_nan` smoke flag and the localization tests).  Pure-numpy
+    host-side edit — jnp ops here would fire compile events that the
+    recompile watcher counts as steady-state recompiles."""
+    import numpy as np
+
+    with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [leaf for _, leaf in with_path]
+    for i, (path, leaf) in enumerate(with_path):
+        name = _path_str(path)
+        if pattern in name and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            arr = np.array(leaf, copy=True)  # ml_dtypes-aware (bf16 storage)
+            arr.reshape(-1)[0] = np.nan
+            leaves[i] = arr
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+    raise ValueError(f"no floating leaf path contains {pattern!r}")
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring helpers (shared by train_dalle and train_vae)
+# ---------------------------------------------------------------------------
+
+def make_alarm_writer(tele, registry=None):
+    """`on_alarm` callback for DivergenceMonitor: bump the alarm counter and
+    mirror the alarm into the telemetry event stream (`kind: "alarm"`,
+    type-prefixed `health_*` — the same stream recompile/FLOPs alarms use)."""
+    def on_alarm(a):
+        if registry is not None:
+            registry.counter("health/alarms").inc()
+        if tele is not None:
+            tele.spans.write_event(
+                "alarm", type=f"health_{a['type']}",
+                **{k: v for k, v in a.items() if k != "type"},
+            )
+    return on_alarm
+
+
+def publish_and_observe(health, paths, monitor, step, tele=None,
+                        registry=None, echo=None):
+    """The per-health-step host block both training CLIs run: publish the
+    fetched health pytree (the one deliberate device→host sync), feed the
+    divergence monitor, write the `kind: "health"` telemetry record, and
+    echo any alarms.  Returns (record, alarms)."""
+    rec = publish(health, paths, registry=registry)
+    alarms = monitor.observe(step, rec)
+    if tele is not None:
+        tele.spans.write_event("health", step=step, **rec)
+    if echo is not None:
+        for a in alarms:
+            echo(f"[health] ALARM {a}")
+    return rec, alarms

@@ -107,9 +107,36 @@ class Histogram:
     def mean(self) -> Optional[float]:
         return self.total / self.count if self.count else None
 
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (q in [0, 1]) from the log2 buckets: find
+        the bucket holding the q·count-th sample and interpolate linearly
+        inside its [2^(i-1), 2^i) range, clamped to the observed min/max.
+        Worst-case error is the bucket width (a factor of 2) — plenty for
+        the latency tables health_report/telemetry_report render."""
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0
+        for b, c in sorted(self._buckets.items()):
+            if cum + c >= target:
+                lo = 0.0 if b <= -1074 else 2.0 ** (b - 1)
+                hi = 2.0 ** b
+                frac = (target - cum) / c
+                val = lo + (hi - lo) * frac
+                if self.min is not None:
+                    val = max(val, self.min)
+                if self.max is not None:
+                    val = min(val, self.max)
+                return val
+            cum += c
+        return self.max
+
     def _snapshot(self, reset_window: bool) -> Dict[str, Any]:
         out = {"count": self.count, "total": self.total, "mean": self.mean,
                "min": self.min, "max": self.max,
+               "p50": self.percentile(0.5),
+               "p95": self.percentile(0.95),
+               "p99": self.percentile(0.99),
                "log2_buckets": {str(k): v for k, v in sorted(self._buckets.items())}}
         return out
 

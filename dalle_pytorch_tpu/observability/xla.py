@@ -139,6 +139,13 @@ class CompileWatcher:
         after this is a recompilation worth alarming on."""
         self._armed = True
 
+    @property
+    def armed(self) -> bool:
+        """True once steady state was declared — callers about to dispatch a
+        KNOWN-new executable (e.g. the first health diagnostic step) check
+        this to decide whether its compile needs a `suspended()` shield."""
+        return self._armed
+
     def suspended(self):
         """Context: ignore compile events inside (telemetry's OWN compiles —
         e.g. a cost-analysis `.compile()` fallback — must not count as

@@ -4,6 +4,11 @@ One fused op: scores on the MXU with f32 accumulation, mask applied as an
 additive fill, f32 softmax, values matmul.  Sparsity variants pass a static
 pattern mask (ops/masks.py); XLA fuses the mask into the softmax and the
 Pallas kernels (kernels/) skip fully-masked blocks outright.
+
+Health tap: when a `health.capture_taps()` context is active (the train
+step's diagnostic probe forward), exact attention-logit max and row-entropy
+stats are exported.  `taps_active()` is a Python-level check, so the normal
+trace carries zero extra ops.
 """
 from __future__ import annotations
 
@@ -11,6 +16,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+from dalle_pytorch_tpu.observability import health as health_mod
 from dalle_pytorch_tpu.ops.stable import stable_softmax
 
 
@@ -32,5 +38,7 @@ def attend(
     else:
         attn = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
         attn = attn / jnp.sum(attn, axis=-1, keepdims=True)
+    if health_mod.taps_active():
+        health_mod.tap_attention("attn_dense", scores=scores, probs=attn)
     out = jnp.einsum("...ij,...jd->...id", attn.astype(dtype), v, preferred_element_type=jnp.float32)
     return out.astype(dtype)

@@ -21,6 +21,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from dalle_pytorch_tpu.core.pytree import cast_floating
+from dalle_pytorch_tpu.observability import health as health_mod
 from dalle_pytorch_tpu.parallel.mesh import BATCH_AXES
 from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs
 
@@ -115,7 +116,14 @@ def make_train_step(
 
     init_fn(params) -> TrainState (sharded when a mesh is given).
     step_fn(state, batch, key) -> (state, metrics); batch leaves have leading
-    dim grad_accum * microbatch and are sharded over the data axes."""
+    dim grad_accum * microbatch and are sharded over the data axes.
+
+    step_fn additionally accepts a STATIC keyword `with_health=True` that
+    compiles a second "diagnostic step" executable whose metrics carry a
+    `health` pytree (observability/health.py: per-leaf grad/param/update
+    norms, nonfinite localization vectors, activation taps from a probe
+    forward).  The default executable's HLO is unchanged — diagnostics cost
+    nothing except on the steps the caller asks for them."""
 
     ls_enabled = settings.loss_scale is not None
     ls_dynamic = settings.loss_scale == "dynamic"
@@ -214,7 +222,34 @@ def make_train_step(
     # allow schedules that consume the loss (e.g. reduce_on_plateau)
     optimizer = optax.with_extra_args_support(optimizer)
 
-    def step_fn_inner(state: TrainState, batch, key):
+    def _health_outputs(state, batch, loss_key, grads, loss, new_params):
+        """Diagnostic outputs (with_health=True executable only): per-leaf
+        numerics plus an activation-tap probe — one extra PLAIN forward on
+        the first microbatch under capture_taps().  The probe is separate
+        from the differentiated forward because tap() must not record
+        jax.grad's inner tracers (they would leak out of that trace)."""
+        with jax.named_scope("health"):
+            h = health_mod.tree_health(state.params, grads, new_params)
+            h["loss_nonfinite"] = (~jnp.isfinite(loss)).astype(jnp.int32)
+            accum = settings.grad_accum
+            probe_batch = batch if accum == 1 else jax.tree_util.tree_map(
+                lambda x: x[: x.shape[0] // accum], batch
+            )
+            with health_mod.capture_taps() as taps:
+                probe_loss = loss_fn(
+                    cast_floating(state.params, settings.compute_dtype),
+                    probe_batch, loss_key,
+                )
+            h["taps"] = taps
+            # taps from scan/remat inner traces are dropped (their tracers
+            # cannot escape); the count makes the absence visible
+            h["taps_dropped_inner_trace"] = jnp.asarray(
+                health_mod.taps_skipped(), jnp.int32
+            )
+            h["probe_loss"] = probe_loss
+        return h
+
+    def step_fn_inner(state: TrainState, batch, key, with_health: bool = False):
         if lowp:
             # reserve a rounding key BEFORE the loss consumes the stream
             key, round_key = jax.random.split(key)
@@ -267,6 +302,10 @@ def make_train_step(
             params, opt_state = do_update(grads, inner_opt_state, state.params, round_key)
             new_state = TrainState(state.step + 1, params, opt_state)
             metrics = {"loss": loss, "grad_norm": gnorm}
+            if with_health:
+                metrics["health"] = _health_outputs(
+                    state, batch, key, grads, loss, params
+                )
             return new_state, metrics
 
         # fp16-style overflow handling: a nonfinite gradient skips the step
@@ -299,22 +338,28 @@ def make_train_step(
             "loss_scale": new_scale,
             "skipped": (~finite).astype(jnp.int32),
         }
+        if with_health:
+            metrics["health"] = _health_outputs(
+                state, batch, key, grads, loss, params
+            )
         return new_state, metrics
 
     if mesh is None:
-        return init_fn, jax.jit(step_fn_inner, donate_argnums=0)
+        return init_fn, jax.jit(
+            step_fn_inner, donate_argnums=0, static_argnames=("with_health",)
+        )
 
     batch_sh = NamedSharding(mesh, P(BATCH_AXES))
 
-    def step_fn(state, batch, key):
+    def step_fn(state, batch, key, with_health: bool = False):
         batch = jax.tree_util.tree_map(
             lambda x: jax.lax.with_sharding_constraint(x, batch_sh), batch
         )
-        return step_fn_inner(state, batch, key)
+        return step_fn_inner(state, batch, key, with_health=with_health)
 
-    jitted = jax.jit(step_fn, donate_argnums=0)
+    jitted = jax.jit(step_fn, donate_argnums=0, static_argnames=("with_health",))
 
-    def with_mesh_ctx(state, batch, key):
+    def with_mesh_ctx(state, batch, key, with_health: bool = False):
         # mesh in context during trace + dispatch so models can use raw
         # PartitionSpec constraints (e.g. the transformer's seq_shard_axis);
         # mesh_context also publishes plain user-built Meshes to
@@ -322,7 +367,7 @@ def make_train_step(
         from dalle_pytorch_tpu.parallel.mesh import mesh_context
 
         with mesh_context(mesh):
-            return jitted(state, batch, key)
+            return jitted(state, batch, key, with_health=with_health)
 
     # telemetry reaches through the closure: observability.step_cost_analysis
     # lowers `.jitted` inside `.mesh`'s context for the XLA FLOPs cross-check

@@ -161,10 +161,30 @@ def unflatten_like(template: Any, saved: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _meta_default(o):
+    """JSON fallback for numpy values landing in checkpoint metadata — e.g.
+    the health monitor's persisted alarm state (EMA grad norm, divergence
+    onset), which is built from fetched device metrics and would otherwise
+    make the whole save raise on an np.float32."""
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.bool_):
+        return bool(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(
+        f"checkpoint meta value of type {type(o).__name__} is not JSON-serializable"
+    )
+
+
 def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> None:
-    """trees: named pytrees of arrays; meta: JSON-serializable metadata."""
+    """trees: named pytrees of arrays; meta: JSON-serializable metadata
+    (numpy scalars/arrays are coerced)."""
     payload = {
-        "__meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        "__meta": np.frombuffer(json.dumps(meta, default=_meta_default).encode(),
+                                dtype=np.uint8),
         "__format": np.array(FORMAT_VERSION, dtype=np.int64),
     }
     for name, tree in trees.items():
@@ -309,7 +329,7 @@ def save_sharded(directory: str, state: Any, meta: Optional[Dict[str, Any]] = No
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path / "state", state, force=True)
     if meta is not None and jax.process_index() == 0:
-        (path / "meta.json").write_text(json.dumps(meta))
+        (path / "meta.json").write_text(json.dumps(meta, default=_meta_default))
 
 
 def load_sharded(

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Render training-health records from a telemetry spans JSONL.
+
+    python tools/health_report.py /tmp/tele/dalle.spans.jsonl
+    python tools/health_report.py /tmp/tele           # picks *.spans.jsonl
+
+Reads the `kind: "health"` records the training loop writes on health steps
+(--health_every) plus the health alarms, and prints:
+
+  * the per-layer table of the LAST health step (grad/param/update norms,
+    update-to-weight ratio, nonfinite counts) — worst update_ratio first;
+  * the global grad-norm trajectory across health steps;
+  * activation-tap and codebook stats;
+  * all health alarms, flagging the step where divergence began and the
+    first offending layer path.
+
+Pure stdlib; works on a partially-written file from a live run."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    p = Path(path)
+    if p.is_dir():
+        candidates = sorted(p.glob("*.spans.jsonl"))
+        if not candidates:
+            raise SystemExit(f"no *.spans.jsonl under {p}")
+        p = candidates[0]
+    records = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a live run
+    return records
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "NaN"
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e4 or abs(v) < 1e-3:
+            return f"{v:.3e}"
+        return f"{v:.4f}"
+    return str(v)
+
+
+def build_report(records: List[Dict[str, Any]], max_layers: int = 40) -> str:
+    health = [r for r in records if r.get("kind") == "health"]
+    alarms = [r for r in records if r.get("kind") == "alarm"
+              and str(r.get("type", "")).startswith("health_")]
+
+    out: List[str] = []
+    if not health:
+        out.append("no health records found (run with --health_every N?)")
+    else:
+        last = health[-1]
+        step = last.get("step")
+        layers = last.get("layers", [])
+        out.append(f"per-layer health at step {step} "
+                   f"({len(layers)} leaves; sorted by update_ratio, "
+                   f"nonfinite first)")
+        header = (f"{'layer':<48} {'grad_norm':>12} {'param_norm':>12} "
+                  f"{'upd_ratio':>10} {'nonfinite':>10}")
+        out.append(header)
+        out.append("-" * len(header))
+
+        def _sort_key(row):
+            nf = row.get("grad_nonfinite", 0) + row.get("param_nonfinite", 0)
+            r = row.get("update_ratio")
+            r = -1.0 if r is None or r != r else r  # NaN sorts with nonfinite
+            return (-nf, -r)
+
+        rows = sorted(layers, key=_sort_key)
+        shown = rows[:max_layers]
+        for row in shown:
+            nf = row.get("grad_nonfinite", 0) + row.get("param_nonfinite", 0)
+            path = row["path"]
+            if len(path) > 48:
+                path = "..." + path[-45:]
+            out.append(
+                f"{path:<48} {_fmt(row.get('grad_norm')):>12} "
+                f"{_fmt(row.get('param_norm')):>12} "
+                f"{_fmt(row.get('update_ratio')):>10} "
+                f"{(str(nf) + ' !!') if nf else '0':>10}"
+            )
+        if len(rows) > max_layers:
+            out.append(f"  ... {len(rows) - max_layers} more leaves")
+
+        out.append("")
+        out.append("global grad-norm trajectory (health steps)")
+        for h in health[-20:]:
+            g = h.get("grad_norm_global")
+            nf = h.get("first_nonfinite")
+            marker = f"   <-- NONFINITE: {nf} ({h.get('first_nonfinite_kind')})" if nf else ""
+            out.append(f"  step {h.get('step'):>6}: {_fmt(g):>12}{marker}")
+
+        taps = last.get("taps")
+        if taps:
+            out.append("")
+            out.append(f"activation taps (step {step})")
+            for name, stats in sorted(taps.items()):
+                brief = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(stats.items()))
+                out.append(f"  {name:<24} {brief}")
+        cb = {k: last[k] for k in
+              ("codebook_usage", "codebook_perplexity", "codebook_entropy",
+               "gumbel_temp", "code_hist_nonzero", "code_hist_max_frac")
+              if k in last}
+        if cb:
+            out.append("")
+            out.append(f"codebook health (step {step})")
+            for k, v in cb.items():
+                out.append(f"  {k:<24} {_fmt(v)}")
+
+    out.append("")
+    if alarms:
+        out.append(f"HEALTH ALARMS ({len(alarms)}):")
+        onset = next((a for a in alarms if a.get("divergence_began")), None)
+        if onset is not None:
+            path = onset.get("path")
+            out.append(
+                f"  divergence began at step {onset.get('step')} "
+                f"({onset.get('type')}"
+                + (f", first offending layer: {path}" if path else "")
+                + ")"
+            )
+        for a in alarms:
+            detail = {k: v for k, v in a.items()
+                      if k not in ("kind", "ts", "divergence_began")}
+            out.append(f"  [{a.get('type')}] {detail}")
+    else:
+        out.append("health alarms: none")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="spans JSONL file, or a telemetry directory")
+    parser.add_argument("--max-layers", type=int, default=40,
+                        help="max per-layer rows to print")
+    args = parser.parse_args(argv)
+    try:
+        print(build_report(load_records(args.path), max_layers=args.max_layers))
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""AST lint: host-sync calls inside modules that must stay jit-pure.
+
+A device→host synchronization inside code that runs under `jax.jit` tracing
+either crashes ("TracerConversionError") or — worse — silently runs at trace
+time on placeholder values and bakes a wrong constant into the compiled
+step.  The observability stack's core promise is "no per-step host sync";
+this lint makes that promise mechanical for the modules meant to keep it:
+
+    dalle_pytorch_tpu/ops/               (attention math, masks, sampling)
+    dalle_pytorch_tpu/kernels/           (Pallas flash attention)
+    dalle_pytorch_tpu/parallel/train_step.py
+    dalle_pytorch_tpu/observability/health.py   (in-graph half; the host
+                                                 half lives in health_host.py)
+
+Flagged call shapes:
+
+  * ``x.item()``                        — the canonical scalar sync
+  * ``np.asarray(x)`` / ``np.array(x)`` — numpy conversion of (potentially)
+                                          traced values; building *new* host
+                                          arrays (``np.ones``, ``np.tril``)
+                                          is fine and not flagged
+  * ``jax.device_get(x)`` / ``jax.block_until_ready(x)``
+  * ``float(x)`` / ``int(x)`` where ``x`` is a bare name, attribute, or
+    subscript (``float(loss)``, ``float(metrics["loss"])``).  Shape/config
+    arithmetic (``int((1 - thres) * v)``, ``int(math.ceil(...))``,
+    ``int(x.shape[0])``) is allowed — those are static Python values.
+
+A line whose source contains ``host-sync-ok`` is waived (for deliberate
+trace-time work on STATIC values, e.g. the flash kernel's static-mask
+tile-liveness table).  Run directly for a repo check, or through
+tests/test_lint.py where it gates CI.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+# modules meant to stay jit-pure, relative to the repo root
+JIT_PURE = (
+    "dalle_pytorch_tpu/ops",
+    "dalle_pytorch_tpu/kernels",
+    "dalle_pytorch_tpu/parallel/train_step.py",
+    "dalle_pytorch_tpu/observability/health.py",
+)
+
+WAIVER = "host-sync-ok"
+
+
+@dataclasses.dataclass
+class Finding:
+    file: str
+    line: int
+    rule: str
+    snippet: str
+
+    def __str__(self):
+        return f"{self.file}:{self.line}: [{self.rule}] {self.snippet.strip()}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'np.asarray' for Attribute chains rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _shape_like(node: ast.AST) -> bool:
+    """True for expressions that are static shape/config arithmetic: any
+    subtree mentioning `.shape`, `.ndim`, `.size`, `len(...)`, or `math.*`."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call):
+            name = _dotted(sub.func)
+            if name == "len" or (name or "").startswith("math."):
+                return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, filename: str, src_lines: List[str],
+                 numpy_aliases: set):
+        self.filename = filename
+        self.src_lines = src_lines
+        self.numpy_aliases = numpy_aliases
+        self.findings: List[Finding] = []
+
+    def _line(self, lineno: int) -> str:
+        try:
+            return self.src_lines[lineno - 1]
+        except IndexError:
+            return ""
+
+    def _flag(self, node: ast.AST, rule: str):
+        line = self._line(node.lineno)
+        # waiver on the flagged line or the comment line directly above it
+        if WAIVER in line or WAIVER in self._line(node.lineno - 1):
+            return
+        self.findings.append(Finding(self.filename, node.lineno, rule, line))
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        # x.item()
+        if isinstance(func, ast.Attribute) and func.attr == "item" and not node.args:
+            self._flag(node, "item")
+        name = _dotted(func)
+        if name is not None:
+            root = name.split(".")[0]
+            tail = name.split(".", 1)[1] if "." in name else ""
+            if root in self.numpy_aliases and tail in ("asarray", "array"):
+                self._flag(node, "np-asarray")
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                self._flag(node, name.split(".")[1])
+        # float(x) / int(x) on value-shaped expressions
+        if (isinstance(func, ast.Name) and func.id in ("float", "int")
+                and len(node.args) == 1 and not node.keywords):
+            arg = node.args[0]
+            if (isinstance(arg, (ast.Name, ast.Subscript, ast.Attribute))
+                    and not _shape_like(arg)):
+                self._flag(node, f"{func.id}-cast")
+        self.generic_visit(node)
+
+
+def _numpy_aliases(tree: ast.Module) -> set:
+    aliases = {"numpy"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
+    tree = ast.parse(src, filename=filename)
+    visitor = _Visitor(filename, src.splitlines(), _numpy_aliases(tree))
+    visitor.visit(tree)
+    return visitor.findings
+
+
+def lint_paths(root: str, targets=JIT_PURE) -> List[Finding]:
+    root_p = Path(root)
+    findings: List[Finding] = []
+    files: List[Path] = []
+    for t in targets:
+        p = root_p / t
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.exists():
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"lint target {p} does not exist")
+    for f in files:
+        findings.extend(lint_source(f.read_text(), str(f.relative_to(root_p))))
+    return findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=str(Path(__file__).resolve().parent.parent),
+                        help="repo root (default: this file's parent's parent)")
+    args = parser.parse_args(argv)
+    findings = lint_paths(args.root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} host-sync finding(s) in jit-pure modules")
+        return 1
+    print("host-sync lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

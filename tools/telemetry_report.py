@@ -50,6 +50,7 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
     checks = [r for r in records if r.get("kind") == "flops_crosscheck"]
     compile_summaries = [r for r in records if r.get("kind") == "compile_summary"]
     metrics = [r for r in records if r.get("kind") == "metrics"]
+    healths = {r.get("step"): r for r in records if r.get("kind") == "health"}
 
     out: List[str] = []
     if not steps:
@@ -65,6 +66,10 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
         )
         cols = names + (["other"] if other_needed else [])
         header = f"{'step':>6} {'total_s':>8} " + " ".join(f"{n + ' %':>12}" for n in cols)
+        if healths:
+            # health-summary column: global grad-norm on health steps, the
+            # first offending layer path when the step went non-finite
+            header += f" {'health':>24}"
         out.append("per-step time attribution")
         out.append(header)
         out.append("-" * len(header))
@@ -86,6 +91,18 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
             if other_needed:
                 pct = 100.0 * max(total - accounted, 0.0) / total if total > 0 else 0.0
                 row.append(f"{pct:>11.1f}%")
+            if healths:
+                h = healths.get(s["step"])
+                if h is None:
+                    hcol = "-"
+                elif h.get("first_nonfinite"):
+                    hcol = "NONFINITE " + h["first_nonfinite"]
+                    if len(hcol) > 24:
+                        hcol = hcol[:21] + "..."
+                else:
+                    g = h.get("grad_norm_global")
+                    hcol = f"|g|={g:.3g}" if g is not None else "ok"
+                row.append(f"{hcol:>24}")
             out.append(" ".join(row))
 
         # aggregate attribution over all steps
