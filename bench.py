@@ -290,6 +290,39 @@ def main():
         "tracked_leaves": len(jax.tree_util.tree_leaves(state.params)),
     }
 
+    # async-checkpoint stall (ISSUE 3): what a periodic save costs the step
+    # loop — synchronous (gather + serialize + fsync inline) vs the async
+    # writer (gather + enqueue only; serialize/fsync on the writer thread).
+    # Same payload both ways: this model's full weights + optimizer state.
+    import tempfile
+
+    from dalle_pytorch_tpu.training.checkpoint import save_checkpoint, to_host
+    from dalle_pytorch_tpu.training.resilience import AsyncCheckpointWriter
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        t0 = time.perf_counter()
+        ckpt_trees = {"weights": to_host(state.params),
+                      "opt_state": to_host(state.opt_state)}
+        gather_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        save_checkpoint(f"{ckpt_dir}/sync.npz", ckpt_trees, {"step": 0})
+        sync_write_s = time.perf_counter() - t0
+        ckpt_writer = AsyncCheckpointWriter()
+        t0 = time.perf_counter()
+        ckpt_trees = {"weights": to_host(state.params),
+                      "opt_state": to_host(state.opt_state)}
+        ckpt_writer.submit(f"{ckpt_dir}/async.npz", ckpt_trees, {"step": 0})
+        async_stall_s = time.perf_counter() - t0
+        ckpt_writer.close()
+    sync_stall_s = gather_s + sync_write_s
+    async_checkpoint_row = {
+        "gather_s": round(gather_s, 4),
+        "serialize_fsync_s": round(sync_write_s, 4),
+        "sync_stall_s": round(sync_stall_s, 4),
+        "async_stall_s": round(async_stall_s, 4),
+        "stall_reduction": round(1.0 - async_stall_s / max(sync_stall_s, 1e-9), 4),
+    }
+
     # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same
     # model; plus the FULL generate-images pipeline (codes -> VAE decode ->
     # CLIP scores), the generate.py-with-rerank path the BASELINE row names
@@ -466,6 +499,7 @@ def main():
         "proxy_dim2048_depth8": proxy_row,
         "telemetry": telemetry_row,
         "health_overhead": health_row,
+        "async_checkpoint": async_checkpoint_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

@@ -35,6 +35,7 @@ from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
 from dalle_pytorch_tpu.parallel.mesh import MeshConfig
 from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState
+from dalle_pytorch_tpu.training import resilience
 from dalle_pytorch_tpu.training.checkpoint import (
     is_sharded_checkpoint,
     load_checkpoint,
@@ -130,8 +131,38 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num_text_tokens", type=int, default=None, help="override tokenizer vocab size")
     # training
     parser.add_argument("--epochs", type=int, default=20)
-    parser.add_argument("--save_every_n_steps", type=int, default=1000)
+    # None = unset (resolved to 1000 / 0-under-dummy_run in main) so an
+    # EXPLICIT --save_every_n_steps survives the dummy-run defaults
+    parser.add_argument("--save_every_n_steps", type=int, default=None,
+                        help="checkpoint cadence (default 1000; 0 disables)")
     parser.add_argument("--keep_n_checkpoints", type=int, default=None)
+    # fault tolerance (training/resilience.py)
+    parser.add_argument("--resume", type=str, default=None, metavar="auto|PATH",
+                        help="'auto': resume from the newest VALID checkpoint "
+                             "next to --dalle_output_file_name (corrupt or "
+                             "truncated files are skipped with a warning; "
+                             "fresh start when none exists) — the flag an "
+                             "outer supervisor restarts with after a "
+                             "preemption (exit code 75).  A path resumes "
+                             "from that checkpoint (same as --dalle_path)")
+    parser.add_argument("--async_checkpoint", type=int, default=1,
+                        help="1 (default): serialize+fsync checkpoints on a "
+                             "background writer thread — the step loop only "
+                             "pays the device->host gather.  0: fully "
+                             "synchronous saves.  (orbax --sharded_checkpoint "
+                             "saves are collective and always synchronous)")
+    parser.add_argument("--rollback_retries", type=int, default=2,
+                        help="on a sustained-nonfinite health alarm "
+                             "(--health_every must be on), roll back to the "
+                             "newest valid checkpoint and retry, at most this "
+                             "many times; then abort with exit code 76.  0 "
+                             "disables automatic rollback")
+    parser.add_argument("--inject_fault", type=str, default=None,
+                        metavar="KIND@STEP",
+                        help="fault-injection harness (tools/chaos.py): "
+                             f"KIND in {{{','.join(resilience.FAULT_KINDS)}}} "
+                             "fired at STEP — e.g. kill-process@40, "
+                             "stall-data@10:30.  Testing only")
     parser.add_argument(
         "--sharded_checkpoint", action="store_true",
         help="save checkpoints in the orbax sharded directory format: every "
@@ -145,7 +176,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
     parser.add_argument("--lr_decay", action="store_true")
-    parser.add_argument("--sample_every_n_steps", type=int, default=100)
+    parser.add_argument("--sample_every_n_steps", type=int, default=None,
+                        help="sample-generation cadence (default 100; 0 disables)")
     parser.add_argument("--log_every_n_steps", type=int, default=10,
                         help="loss/throughput logging cadence (reference logs every 10 iters)")
     parser.add_argument("--num_workers", type=int, default=4,
@@ -196,11 +228,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "second step executable; the normal step's HLO "
                              "is unchanged (zero overhead when off)")
     parser.add_argument("--health_inject_nan", type=str, default=None,
-                        metavar="STEP[:PATTERN]",
+                        metavar="STEP[,STEP...][:PATTERN]",
                         help="test hook: poison the first param leaf whose "
                              "path contains PATTERN (default: first leaf) "
-                             "with NaN before step STEP — exercises NaN "
-                             "localization + the alarm path end to end")
+                             "with NaN before each listed STEP (each fires "
+                             "once) — exercises NaN localization, the alarm "
+                             "path, and (with --rollback_retries) the "
+                             "divergence rollback end to end")
     parser.add_argument("--dummy_run", "--dummy-run", type=int, nargs="?",
                         const=6, default=None, metavar="N",
                         help="telemetry smoke mode: train N steps (default 6) "
@@ -265,31 +299,54 @@ def reconstitute_vae(args, resume=None):
     return pretrained.load_openai_vae_pretrained()
 
 
-def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
-               global_step=0, wandb_run_id=None, health_state=None):
+def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
+                        global_step=0, wandb_run_id=None, health_state=None,
+                        data_state=None):
+    """(trees, meta) for a checkpoint — the device->host gather happens HERE
+    (np.asarray inside to_host), so the result is a consistent snapshot that
+    can be serialized later on the async writer thread.  `data_state`
+    (resilience.data_state_dict) is what makes resume exact: epoch,
+    within-epoch batch cursor, shuffle seed, RNG key."""
     class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
-    save_checkpoint(
-        path,
-        trees={
-            "weights": to_host(state.params),
-            "opt_state": to_host(state.opt_state),
-            "vae_weights": to_host(vae_params),
-        },
-        meta={
-            "hparams": dalle_cfg.to_dict(),
-            "vae_params": vae_meta,
-            "epoch": epoch,
-            "global_step": int(global_step),
-            "wandb_run_id": wandb_run_id,
-            "version": __version__,
-            "vae_class_name": class_name,
-            "scheduler_state": None,
-            "health_state": health_state,
-        },
+    trees = {
+        "weights": to_host(state.params),
+        "opt_state": to_host(state.opt_state),
+        "vae_weights": to_host(vae_params),
+    }
+    meta = {
+        "hparams": dalle_cfg.to_dict(),
+        "vae_params": vae_meta,
+        "epoch": epoch,
+        "global_step": int(global_step),
+        "wandb_run_id": wandb_run_id,
+        "version": __version__,
+        "vae_class_name": class_name,
+        "scheduler_state": None,
+        "health_state": health_state,
+        "data_state": data_state,
+    }
+    return trees, meta
+
+
+def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
+               global_step=0, wandb_run_id=None, health_state=None,
+               data_state=None, writer=None):
+    """Gather + write one npz checkpoint.  With `writer` (an
+    AsyncCheckpointWriter), only the gather runs here — serialization,
+    fsync, atomic rename, and rotation happen on the writer thread and this
+    returns as soon as the job is queued."""
+    trees, meta = build_model_payload(
+        state, dalle_cfg, vae_params, vae_cfg, epoch, global_step=global_step,
+        wandb_run_id=wandb_run_id, health_state=health_state,
+        data_state=data_state,
     )
+    glob_pat = _rotation_glob(path) if keep_n is not None else None
+    if writer is not None:
+        writer.submit(path, trees, meta, keep_n=keep_n, rotation_glob=glob_pat)
+        return
+    save_checkpoint(path, trees, meta)
     if keep_n is not None:
-        d = str(Path(path).parent)
-        rotate_checkpoints(d, _rotation_glob(path), keep_n)
+        rotate_checkpoints(str(Path(path).parent), glob_pat, keep_n)
 
 
 def _rotation_glob(path) -> str:
@@ -306,12 +363,13 @@ def _rotation_glob(path) -> str:
 
 def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                        keep_n=None, global_step=0, wandb_run_id=None,
-                       health_state=None):
+                       health_state=None, data_state=None):
     """Distributed save: the TrainState goes through orbax, each host writing
     only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
     never gathered (`save_model`'s np.asarray would pull the full arrays to
     one host).  The small frozen VAE rides in a sidecar npz inside the
-    checkpoint directory.  Collective: call from ALL processes."""
+    checkpoint directory.  Collective: call from ALL processes (and always
+    synchronous — the async writer covers the npz path only)."""
     class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
     meta = {
         "hparams": dalle_cfg.to_dict(),
@@ -323,6 +381,7 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
         "vae_class_name": class_name,
         "scheduler_state": None,
         "health_state": health_state,
+        "data_state": data_state,
     }
     path = Path(path)
     save_sharded(
@@ -353,8 +412,12 @@ def _apply_dummy_run_defaults(args):
     args.batch_size = 2 * _jax.device_count()
     args.epochs = 1
     args.num_workers = min(args.num_workers, 2)
-    args.save_every_n_steps = 0
-    args.sample_every_n_steps = 0
+    # respect EXPLICIT cadences (the crash-and-resume tests run dummy mode
+    # with --save_every_n_steps 1); only unset (None) cadences go quiet
+    if args.save_every_n_steps is None:
+        args.save_every_n_steps = 0
+    if args.sample_every_n_steps is None:
+        args.sample_every_n_steps = 0
     args.log_every_n_steps = max(1, min(args.log_every_n_steps, 2))
     return args
 
@@ -367,10 +430,59 @@ def main(argv=None):
         args = _apply_dummy_run_defaults(args)
     elif args.image_text_folder is None:
         raise SystemExit("--image_text_folder is required (unless --dummy_run)")
+    # resolve unset cadences (None sentinel so --dummy_run can tell an
+    # explicit value from an untouched default)
+    if args.save_every_n_steps is None:
+        args.save_every_n_steps = 1000
+    if args.sample_every_n_steps is None:
+        args.sample_every_n_steps = 100
 
     be = backend_mod.set_backend_from_args(args)
     be.initialize()
     is_root = be.is_root_worker()
+
+    out_file = f"{args.dalle_output_file_name}.pt"
+
+    # --resume: 'auto' discovers the newest VALID checkpoint next to the
+    # output file (falling back past truncated/corrupt ones), a path resumes
+    # from that file.  Either way it feeds the existing --dalle_path plumbing.
+    if args.resume is not None:
+        if args.dalle_path is not None:
+            raise SystemExit("--resume and --dalle_path are mutually exclusive")
+        if args.resume == "auto":
+            if args.sharded_checkpoint:
+                # orbax checkpoints are directories; discovery/validation
+                # covers the npz format only — fail loudly rather than
+                # silently fresh-starting over existing progress
+                raise SystemExit(
+                    "--resume auto supports npz checkpoints only; resume a "
+                    "--sharded_checkpoint run with --dalle_path <checkpoint dir>"
+                )
+            if be.get_world_size() > 1 and is_root:
+                # every process globs its own disk; without a shared
+                # filesystem the workers would silently fresh-start
+                print("[resilience] --resume auto on a multi-process run "
+                      "assumes the output dir is on a SHARED filesystem "
+                      "(all processes must discover the same checkpoint)")
+            found, _found_meta = resilience.find_latest_valid_checkpoint(
+                out_file, log=print if is_root else None
+            )
+            if found is not None:
+                args.dalle_path = found
+                if is_root:
+                    print(f"[resilience] --resume auto: resuming from {found}")
+            elif is_root:
+                print("[resilience] --resume auto: no valid checkpoint found "
+                      f"next to {out_file}; starting fresh")
+        else:
+            args.dalle_path = args.resume
+
+    # fault-injection harness (--inject_fault KIND@STEP, tools/chaos.py)
+    injector = None
+    if args.inject_fault is not None:
+        injector = resilience.FaultInjector(
+            resilience.parse_fault(args.inject_fault)
+        ).install()
 
     tokenizer = get_tokenizer(args)
 
@@ -498,7 +610,7 @@ def main(argv=None):
     # data
     be.check_batch_size(args.batch_size)
     if args.dummy_run is not None:
-        def data_iter(epoch):
+        def _dummy_batches(epoch):
             rs = np.random.RandomState(args.seed + epoch)
             n = int(args.dummy_run)
             for i in range(n):
@@ -515,6 +627,13 @@ def main(argv=None):
                         bs, vae_cfg.image_size, vae_cfg.image_size, 3
                     ).astype(np.float32),
                 }
+
+        def data_iter(epoch, skip=0):
+            import itertools
+
+            # islice keeps the RandomState draw sequence identical to an
+            # uninterrupted run, so a resumed dummy run sees the same batches
+            return itertools.islice(_dummy_batches(epoch), skip, None)
     elif args.wds:
         from dalle_pytorch_tpu.data.loader import expand_shard_spec, is_remote_shard
 
@@ -527,14 +646,21 @@ def main(argv=None):
             shards = sorted(glob(args.image_text_folder))
         assert shards, f"no tar shards match {args.image_text_folder}"
 
-        def data_iter(epoch):
+        def data_iter(epoch, skip=0):
+            import itertools
+
             stream = iterate_tar_shards(
                 shards, vae_cfg.image_size, dalle_cfg.text_seq_len, tokenizer,
                 truncate_captions=args.truncate_captions,
                 process_index=be.get_rank(), process_count=be.get_world_size(),
                 seed=args.seed + epoch, num_workers=args.num_workers,
             )
-            return batch_tar_stream(stream, args.batch_size)
+            # tar streams have no random access: the fast-forward re-reads
+            # (and discards) the first `skip` batches — resume is exact, it
+            # just pays the stream bytes for the skipped prefix
+            return itertools.islice(
+                batch_tar_stream(stream, args.batch_size), skip, None
+            )
     else:
         dataset = TextImageDataset(
             args.image_text_folder,
@@ -547,11 +673,11 @@ def main(argv=None):
         )
         assert len(dataset) > 0, "dataset is empty"
 
-        def data_iter(epoch):
+        def data_iter(epoch, skip=0):
             return iterate_batches(
                 dataset, args.batch_size, seed=args.seed + epoch,
                 process_index=be.get_rank(), process_count=be.get_world_size(),
-                num_workers=args.num_workers,
+                num_workers=args.num_workers, skip_batches=skip,
             )
 
     use_bf16 = args.bf16 or args.fp16 or args.amp
@@ -696,44 +822,83 @@ def main(argv=None):
             print(f"[health] diagnostics every {args.health_every} step(s) "
                   f"({len(health_paths)} tracked param leaves; render with "
                   "tools/health_report.py)")
-    inject_step = None
+    inject_steps = []
     inject_pattern = ""
     if args.health_inject_nan is not None:
+        # STEP[,STEP...][:PATTERN] — each entry fires once, in order; a
+        # repeated step (e.g. "3,3") re-poisons after a rollback replays it,
+        # which is how the rollback-budget-exhaustion path is exercised
         part = args.health_inject_nan.split(":", 1)
-        inject_step = int(part[0])
+        inject_steps = [int(s) for s in part[0].split(",")]
         inject_pattern = part[1] if len(part) > 1 else ""
 
-    out_file = f"{args.dalle_output_file_name}.pt"
-    start_epoch = (resume_meta or {}).get("epoch", 0)
+    # exact-resume cursor: prefer the checkpoint's data_state (epoch,
+    # within-epoch batch cursor, RNG key) over the coarse epoch number, so a
+    # mid-epoch resume continues batch-for-batch instead of replaying or
+    # skipping work
+    data_state = (resume_meta or {}).get("data_state") or {}
+    resume_epoch = data_state.get("epoch", (resume_meta or {}).get("epoch", 0))
+    pending_skip = data_state.get("epoch_batches", 0) or 0
     # restoring the step counter keeps save/sample cadences and checkpoint
     # rotation continuous across resume (the reference's resume restores its
     # global step through the DeepSpeed engine, train_dalle.py:531-532)
     global_step = (resume_meta or {}).get("global_step", 0) or 0
+    if data_state.get("rng_key") is not None:
+        key = resilience.decode_rng_key(data_state["rng_key"])
+    else:
+        key = jax.random.PRNGKey(args.seed + 1)
+    if pending_skip and is_root:
+        print(f"[resilience] resuming mid-epoch: epoch {resume_epoch}, "
+              f"fast-forwarding {pending_skip} batch(es)")
 
-    def save(path, epoch, keep_n=None, step=None):
+    # async checkpoint writer: serialization/fsync/rename off the step loop
+    # (the orbax sharded path is collective and stays synchronous)
+    writer = None
+    if args.async_checkpoint and not args.sharded_checkpoint:
+        writer = resilience.AsyncCheckpointWriter()
+    # preemption-safe shutdown: SIGTERM/SIGINT finish the in-flight step,
+    # write an emergency checkpoint, and exit EXIT_PREEMPTED (75) so a
+    # supervisor can restart with --resume auto
+    shutdown = resilience.ShutdownHandler().install()
+
+    def save(path, epoch, keep_n=None, step=None, ds_epoch=0, ds_batches=0):
         # `step` is the NEXT step to run after resume; mid-loop callers pass
-        # global_step + 1 (the increment happens at loop end)
-        fn = save_model_sharded if args.sharded_checkpoint else save_model
+        # global_step + 1 (the increment happens at loop end).  ds_epoch /
+        # ds_batches are the exact-resume cursor: the epoch a resumed run
+        # re-enters and how many of its batches to fast-forward.  The
+        # `checkpoint` span covers only the device->host gather (+ enqueue)
+        # when the async writer is on — the serialize/fsync tail runs on the
+        # writer thread and shows up in checkpoint_write_s instead.
+        ds = resilience.data_state_dict(
+            epoch=ds_epoch, epoch_batches=ds_batches,
+            seed=args.seed, rng_key=key,
+        )
         t0 = time.perf_counter()
+        health_state = (health_monitor.state_dict()
+                        if health_monitor is not None else None)
         with telemetry.span("checkpoint", path=str(path)):
-            fn(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
-               keep_n=keep_n,
-               global_step=global_step if step is None else step,
-               wandb_run_id=logger.run_id,
-               health_state=(health_monitor.state_dict()
-                             if health_monitor is not None else None))
+            if args.sharded_checkpoint:
+                save_model_sharded(
+                    path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+                    keep_n=keep_n,
+                    global_step=global_step if step is None else step,
+                    wandb_run_id=logger.run_id, health_state=health_state,
+                    data_state=ds)
+            else:
+                save_model(
+                    path, state, dalle_cfg, vae_params, vae_cfg, epoch,
+                    keep_n=keep_n,
+                    global_step=global_step if step is None else step,
+                    wandb_run_id=logger.run_id, health_state=health_state,
+                    data_state=ds, writer=writer)
         obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
-        obs_metrics.counter("checkpoints_saved").inc()
+        if writer is None:
+            # the async writer counts completions itself (checkpoints_saved)
+            obs_metrics.counter("checkpoints_saved").inc()
 
     # orbax saves are collective (every host writes its shards), so they run
     # on all processes; the npz path writes from the root host only
     save_here = is_root or args.sharded_checkpoint
-
-    # save-before-train fail-fast (reference train_dalle.py:591-594)
-    if save_here:
-        save(out_file, start_epoch)
-
-    key = jax.random.PRNGKey(args.seed + 1)
     first_window = True
     flops_checked = False
     checked_recompiles = 0
@@ -742,186 +907,358 @@ def main(argv=None):
     # steady-state recompile alarm (e.g. step 0 is a health step, so the
     # plain executable first compiles at step 1 — after the watcher armed)
     compiled_variants = set()
+    # deferred bad-step accounting: the per-step `skipped` flags stay on
+    # device and are fetched at the log cadence (by which point those steps
+    # have completed), so the guard costs no extra host sync per step
+    skip_pending: list = []
+    rollback_attempts = 0
     import contextlib as _ctx
-    for epoch in range(start_epoch, args.epochs):
-        t_window = time.time()
-        window_start = global_step  # reset with t_window: a stale window
-        # start would count the previous epoch's tail steps against a dt
-        # that excludes their wall time
-        batches = data_iter(epoch)
-        if args.prefetch_batches > 0:
-            # async host->device transfer, overlapping decode + DMA with the
-            # running step (the reference's DataLoader workers + async .cuda())
-            batches = prefetch_to_device(batches, size=args.prefetch_batches)
-        epoch_batches = 0
-        batch_it = iter(batches)
-        while True:
-            if tele is not None:
-                tele.begin_step(global_step)
-            with telemetry.span("data_wait"):
-                device_batch = next(batch_it, None)
-            if device_batch is None:
-                if tele is not None:
-                    tele.abort_step()  # the wait that found the epoch's end
-                break
-            epoch_batches += 1
-            key, sk = jax.random.split(key)
-            device_batch = {
-                "text": jnp.asarray(device_batch["text"]),
-                "image": jnp.asarray(device_batch["image"]),
-            }
-            recompiles_now = (
-                tele.compile_watcher.recompiles
-                if tele is not None and tele.compile_watcher is not None else 0
-            )
-            if tele is not None and (not flops_checked
-                                     or recompiles_now > checked_recompiles):
-                # XLA-vs-analytic FLOPs cross-check: one extra trace (no
-                # second backend compile), shapes taken from the real batch.
-                # Re-checked after every detected recompile — consecutive
-                # divergent checks are what arm the persistent-divergence
-                # alarm (a one-off ragged-batch lowering is not)
-                flops_checked = True
-                checked_recompiles = recompiles_now
-                with telemetry.span("flops_crosscheck"):
-                    from dalle_pytorch_tpu.training.profiling import (
-                        dalle_step_flops, matmul_param_count,
-                    )
 
-                    analytic = dalle_step_flops(
-                        dalle_cfg, int(device_batch["text"].shape[0]),
-                        matmul_param_count(state.params),
-                    )
-                    ratio = tele.crosscheck_flops(
-                        step_fn, (state, device_batch, sk), analytic
-                    )
-                    if tele.compile_watcher is not None:
-                        # re-snapshot: anything the crosscheck itself fired
-                        # must not re-trigger it next step
-                        checked_recompiles = tele.compile_watcher.recompiles
-                    if is_root and ratio is not None:
-                        print(f"[telemetry] compiled/analytic FLOPs ratio: "
-                              f"{ratio:.3f}")
-            health_step = bool(args.health_every) and (
-                global_step % args.health_every == 0
-            )
-            if inject_step is not None and global_step == inject_step:
-                # test hook: poison one param leaf so the localization path
-                # (finite-mask -> first offending path -> alarm) is exercised
-                state = TrainState(
-                    state.step,
-                    health_mod.inject_nan(state.params, inject_pattern),
-                    state.opt_state,
-                )
-                if is_root:
-                    print(f"[health] injected NaN into params "
-                          f"(pattern {inject_pattern!r}) before step {global_step}")
-            new_variant = health_step not in compiled_variants
-            compiled_variants.add(health_step)
-            # shield only post-arm first compiles: pre-arm compiles should
-            # still count toward the compile totals/time
-            suspend = (
-                tele.compile_watcher.suspended()
-                if (new_variant and tele is not None
-                    and tele.compile_watcher is not None
-                    and tele.compile_watcher.armed)
-                else _ctx.nullcontext()
-            )
-            with telemetry.span("dispatch"), suspend:
-                state, metrics = step_fn(
-                    state, device_batch, sk, with_health=health_step
-                )
-            if health_step:
-                # the one deliberate device->host sync of the diagnostics
-                # path: fetch the health pytree, name the leaves, publish
-                with telemetry.span("health_publish"):
-                    health_mod.publish_and_observe(
-                        metrics.pop("health"), health_paths, health_monitor,
-                        global_step, tele=tele, registry=obs_metrics.REGISTRY,
-                        echo=print if is_root else None,
-                    )
-            if args.telemetry_sync and tele is not None:
-                # wait for THIS step's result: per-step wall-clock splits
-                # into data_wait / dispatch / block, the attribution the
-                # telemetry report renders.  --telemetry_sync 0 (or
-                # --telemetry off) restores unbounded dispatch-ahead
-                # (block reads as 0)
-                with telemetry.span("block"):
-                    jax.block_until_ready(metrics["loss"])
-            if tele is not None and "skipped" in metrics:
-                # exact per-step skip accounting.  int() waits for the step's
-                # result; with --telemetry_sync that wait already happened,
-                # without it this is the one forced sync per step the
-                # fp16-parity mode pays for correct skip counts
-                obs_metrics.counter("loss_scale_skips").inc(
-                    int(metrics["skipped"])
-                )
-                obs_metrics.gauge("loss_scale").set(float(metrics["loss_scale"]))
-            obs_metrics.counter("train_steps").inc()
+    def drain_skips():
+        if not skip_pending:
+            return
+        n = sum(int(s) for s in skip_pending)
+        skip_pending.clear()
+        if n:
+            obs_metrics.counter("nonfinite_step_skips").inc(n)
+            if settings.loss_scale is not None:
+                obs_metrics.counter("loss_scale_skips").inc(n)
+            if is_root:
+                print(f"[resilience] skipped {n} poisoned step(s) since "
+                      "the last log (nonfinite gradients)")
 
-            if global_step % args.log_every_n_steps == 0:
-                with telemetry.span("log"):
-                    dt = time.time() - t_window
-                    steps_done = global_step - window_start + 1
-                    record = {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch}
-                    if not first_window:
-                        # the process's first window spans jit compilation —
-                        # minutes for billion-parameter configs — so its rate
-                        # is not a throughput measurement
-                        record["sample_per_sec"] = args.batch_size * steps_done / max(dt, 1e-9)
-                        obs_metrics.gauge("tokens_per_sec").set(
-                            args.batch_size * dalle_cfg.total_seq_len
-                            * steps_done / max(dt, 1e-9)
+    def finish_telemetry():
+        if tele is not None:
+            tele.flush(logger, step=global_step)
+            tele.close()
+        logger.finish()
+
+    def exit_preempted(epoch, epoch_batches):
+        """Tail of the graceful-shutdown path (the in-flight step already
+        finished): emergency checkpoint, flush it durable, hand the
+        supervisor EXIT_PREEMPTED."""
+        # counted here, not in the signal handler (registry locks are not
+        # signal-safe)
+        obs_metrics.counter("shutdown_requests").inc()
+        if be.get_world_size() > 1:
+            # no cross-process agreement on the signal exists: a peer that
+            # checked the flag just before delivery may already be inside
+            # step N+1's collectives, and a collective emergency save (orbax,
+            # or a gather of cross-host-sharded params) would deadlock
+            # against it.  Exit cleanly; resume falls back to the last
+            # periodic checkpoint (at most save_every_n_steps of lost work).
+            if is_root:
+                print("[resilience] multi-process preemption: skipping the "
+                      "emergency checkpoint (no cross-process signal "
+                      "barrier); resume from the last periodic save",
+                      flush=True)
+        elif save_here:
+            step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
+            save(step_file, epoch, keep_n=args.keep_n_checkpoints,
+                 step=global_step + 1, ds_epoch=epoch, ds_batches=epoch_batches)
+        if writer is not None:
+            writer.flush()
+        if is_root:
+            print(f"[resilience] preemption checkpoint written; exiting with "
+                  f"code {resilience.EXIT_PREEMPTED} (restart with "
+                  "--resume auto)", flush=True)
+        finish_telemetry()
+        raise SystemExit(resilience.EXIT_PREEMPTED)
+
+    try:
+        # save-before-train fail-fast (reference train_dalle.py:591-594);
+        # flushed through the async writer so a dead output disk still
+        # fails before compilation burns minutes
+        if save_here:
+            save(out_file, resume_epoch,
+                 ds_epoch=resume_epoch, ds_batches=pending_skip)
+            if writer is not None:
+                writer.flush()
+
+        while True:  # rollback retry loop
+          try:
+            for epoch in range(resume_epoch, args.epochs):
+                t_window = time.time()
+                window_start = global_step  # reset with t_window: a stale
+                # window start would count the previous epoch's tail steps
+                # against a dt that excludes their wall time
+                skip_now, pending_skip = pending_skip, 0
+                batches = data_iter(epoch, skip=skip_now)
+                if args.prefetch_batches > 0:
+                    # async host->device transfer, overlapping decode + DMA
+                    # with the running step (the reference's DataLoader
+                    # workers + async .cuda())
+                    batches = prefetch_to_device(batches, size=args.prefetch_batches)
+                # the cursor counts ABSOLUTE position in the epoch so the
+                # data_state written mid-epoch is a valid fast-forward
+                epoch_batches = skip_now
+                batch_it = iter(batches)
+                while True:
+                    if injector is not None:
+                        injector.at_step(global_step)
+                    if tele is not None:
+                        tele.begin_step(global_step)
+                    with telemetry.span("data_wait"):
+                        device_batch = next(batch_it, None)
+                    if device_batch is None:
+                        if tele is not None:
+                            tele.abort_step()  # the wait that found the epoch's end
+                        break
+                    epoch_batches += 1
+                    key, sk = jax.random.split(key)
+                    device_batch = {
+                        "text": jnp.asarray(device_batch["text"]),
+                        "image": jnp.asarray(device_batch["image"]),
+                    }
+                    recompiles_now = (
+                        tele.compile_watcher.recompiles
+                        if tele is not None and tele.compile_watcher is not None else 0
+                    )
+                    if tele is not None and (not flops_checked
+                                             or recompiles_now > checked_recompiles):
+                        # XLA-vs-analytic FLOPs cross-check: one extra trace (no
+                        # second backend compile), shapes taken from the real batch.
+                        # Re-checked after every detected recompile — consecutive
+                        # divergent checks are what arm the persistent-divergence
+                        # alarm (a one-off ragged-batch lowering is not)
+                        flops_checked = True
+                        checked_recompiles = recompiles_now
+                        with telemetry.span("flops_crosscheck"):
+                            from dalle_pytorch_tpu.training.profiling import (
+                                dalle_step_flops, matmul_param_count,
+                            )
+
+                            analytic = dalle_step_flops(
+                                dalle_cfg, int(device_batch["text"].shape[0]),
+                                matmul_param_count(state.params),
+                            )
+                            ratio = tele.crosscheck_flops(
+                                step_fn, (state, device_batch, sk), analytic
+                            )
+                            if tele.compile_watcher is not None:
+                                # re-snapshot: anything the crosscheck itself fired
+                                # must not re-trigger it next step
+                                checked_recompiles = tele.compile_watcher.recompiles
+                            if is_root and ratio is not None:
+                                print(f"[telemetry] compiled/analytic FLOPs ratio: "
+                                      f"{ratio:.3f}")
+                    health_step = bool(args.health_every) and (
+                        global_step % args.health_every == 0
+                    )
+                    if inject_steps and global_step == inject_steps[0]:
+                        # test hook: poison one param leaf so the localization path
+                        # (finite-mask -> first offending path -> alarm) is exercised.
+                        # Each listed step fires ONCE — a transient corruption — so
+                        # a divergence rollback replaying this step recovers unless
+                        # the spec deliberately repeats it
+                        inject_steps.pop(0)
+                        state = TrainState(
+                            state.step,
+                            health_mod.inject_nan(state.params, inject_pattern),
+                            state.opt_state,
                         )
-                    first_window = False
-                    t_window = time.time()
-                    window_start = global_step + 1
-                    logger.log(record, step=global_step)
-                    if tele is not None:
-                        tele.flush(logger, step=global_step)
-            if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and save_here:
-                step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
-                save(step_file, epoch, keep_n=args.keep_n_checkpoints,
-                     step=global_step + 1)
-            if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
-                with telemetry.span("sample"):
-                    _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
-            if args.flops_profiler:
-                if global_step == 199:
-                    jax.profiler.start_trace("./profile_trace")
-                if global_step == 200:
-                    jax.profiler.stop_trace()
-                    print("profiler trace written to ./profile_trace; stopping (parity with --flops_profiler)")
-                    logger.finish()
-                    if tele is not None:
-                        tele.close()
-                    return state, dalle_cfg
-            if tele is not None:
-                tele.finish_step(global_step)
-            global_step += 1
+                        if is_root:
+                            print(f"[health] injected NaN into params "
+                                  f"(pattern {inject_pattern!r}) before step {global_step}")
+                    new_variant = health_step not in compiled_variants
+                    compiled_variants.add(health_step)
+                    # shield only post-arm first compiles: pre-arm compiles should
+                    # still count toward the compile totals/time
+                    suspend = (
+                        tele.compile_watcher.suspended()
+                        if (new_variant and tele is not None
+                            and tele.compile_watcher is not None
+                            and tele.compile_watcher.armed)
+                        else _ctx.nullcontext()
+                    )
+                    with telemetry.span("dispatch"), suspend:
+                        state, metrics = step_fn(
+                            state, device_batch, sk, with_health=health_step
+                        )
+                    if health_step:
+                        # the one deliberate device->host sync of the diagnostics
+                        # path: fetch the health pytree, name the leaves, publish
+                        with telemetry.span("health_publish"):
+                            _, alarms = health_mod.publish_and_observe(
+                                metrics.pop("health"), health_paths, health_monitor,
+                                global_step, tele=tele, registry=obs_metrics.REGISTRY,
+                                echo=print if is_root else None,
+                            )
+                        if (args.rollback_retries
+                                and not args.sharded_checkpoint
+                                and any(a["type"] == "sustained_nonfinite"
+                                        for a in alarms)):
+                            # the run is NOT recovering on its own: rewind to
+                            # the last good checkpoint (bounded retries below).
+                            # Sharded (orbax) runs keep the pre-rollback
+                            # alarm-only behavior — discovery/validation
+                            # covers the npz format only
+                            raise resilience.RollbackRequested(
+                                global_step, "sustained nonfinite diagnostics"
+                            )
+                    if args.telemetry_sync and tele is not None:
+                        # wait for THIS step's result: per-step wall-clock splits
+                        # into data_wait / dispatch / block, the attribution the
+                        # telemetry report renders.  --telemetry_sync 0 (or
+                        # --telemetry off) restores unbounded dispatch-ahead
+                        # (block reads as 0)
+                        with telemetry.span("block"):
+                            jax.block_until_ready(metrics["loss"])
+                    if "skipped" in metrics:
+                        # defer the fetch: counted at the log cadence by
+                        # drain_skips() (no per-step forced sync)
+                        skip_pending.append(metrics["skipped"])
+                    obs_metrics.counter("train_steps").inc()
 
-        if epoch_batches == 0:
-            # a local-glob spec fails fast at the `assert shards` above, but
-            # remote --wds URLs expand unconditionally and dead shards are
-            # warn-and-continue'd per shard — without this, a typo'd URL
-            # spec would "train" through every epoch in seconds and save an
-            # untrained model (code-review finding, round 5)
-            raise RuntimeError(
-                f"epoch {epoch} produced ZERO batches from "
-                f"{args.image_text_folder!r} — every shard failed to stream "
-                "(see '[tar pipeline] skipping' warnings above) or the "
-                "dataset is smaller than one batch"
+                    if global_step % args.log_every_n_steps == 0:
+                        with telemetry.span("log"):
+                            dt = time.time() - t_window
+                            steps_done = global_step - window_start + 1
+                            record = {"loss": float(be.average_all(metrics["loss"])), "epoch": epoch}
+                            if not first_window:
+                                # the process's first window spans jit compilation —
+                                # minutes for billion-parameter configs — so its rate
+                                # is not a throughput measurement
+                                record["sample_per_sec"] = args.batch_size * steps_done / max(dt, 1e-9)
+                                obs_metrics.gauge("tokens_per_sec").set(
+                                    args.batch_size * dalle_cfg.total_seq_len
+                                    * steps_done / max(dt, 1e-9)
+                                )
+                            drain_skips()
+                            if "loss_scale" in metrics:
+                                obs_metrics.gauge("loss_scale").set(
+                                    float(metrics["loss_scale"])
+                                )
+                            first_window = False
+                            t_window = time.time()
+                            window_start = global_step + 1
+                            logger.log(record, step=global_step)
+                            if tele is not None:
+                                tele.flush(logger, step=global_step)
+                    if args.save_every_n_steps and global_step and global_step % args.save_every_n_steps == 0 and save_here:
+                        step_file = f"{args.dalle_output_file_name}_step{global_step}.npz"
+                        save(step_file, epoch, keep_n=args.keep_n_checkpoints,
+                             step=global_step + 1,
+                             ds_epoch=epoch, ds_batches=epoch_batches)
+                        if injector is not None and injector.wants_checkpoint_fault():
+                            # chaos corrupt/truncate applies to the DURABLE
+                            # file, so drain the writer first
+                            if writer is not None:
+                                writer.flush()
+                            injector.after_checkpoint(step_file, global_step)
+                    if args.sample_every_n_steps and global_step and global_step % args.sample_every_n_steps == 0 and is_root:
+                        with telemetry.span("sample"):
+                            _log_sample(logger, state, dalle_cfg, vae_params, vae_cfg, device_batch, tokenizer, global_step)
+                    if args.flops_profiler:
+                        if global_step == 199:
+                            jax.profiler.start_trace("./profile_trace")
+                        if global_step == 200:
+                            jax.profiler.stop_trace()
+                            print("profiler trace written to ./profile_trace; stopping (parity with --flops_profiler)")
+                            logger.finish()
+                            if tele is not None:
+                                tele.close()
+                            return state, dalle_cfg
+                    if tele is not None:
+                        tele.finish_step(global_step)
+                    if shutdown.requested:
+                        # the in-flight step finished; leave cleanly with an
+                        # emergency checkpoint the supervisor can resume from
+                        drain_skips()
+                        exit_preempted(epoch, epoch_batches)
+                    global_step += 1
+
+                if epoch_batches == 0:
+                    # a local-glob spec fails fast at the `assert shards` above, but
+                    # remote --wds URLs expand unconditionally and dead shards are
+                    # warn-and-continue'd per shard — without this, a typo'd URL
+                    # spec would "train" through every epoch in seconds and save an
+                    # untrained model (code-review finding, round 5).  (A resume
+                    # landing exactly on an epoch boundary has epoch_batches ==
+                    # skip_now > 0 and legitimately rolls straight over.)
+                    raise RuntimeError(
+                        f"epoch {epoch} produced ZERO batches from "
+                        f"{args.image_text_folder!r} — every shard failed to stream "
+                        "(see '[tar pipeline] skipping' warnings above) or the "
+                        "dataset is smaller than one batch"
+                    )
+
+                if save_here:
+                    save(out_file, epoch + 1, ds_epoch=epoch + 1, ds_batches=0)
+                    if writer is not None:
+                        writer.flush()  # artifact logging wants the file durable
+                    if is_root:
+                        logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
+            drain_skips()  # count the tail window's skipped steps too
+            break  # all epochs done
+          except resilience.RollbackRequested as rb:
+            obs_metrics.counter("rollbacks").inc()
+            rollback_attempts += 1
+            try:
+                # release the abandoned data pipeline — the prefetch
+                # producer thread holds device batches in its bounded queue
+                # that the replay would otherwise leave pinned in HBM
+                batch_it.close()
+            except Exception:  # noqa: BLE001 — islice etc. have no close
+                pass
+            if writer is not None:
+                writer.flush()
+            found = found_meta = None
+            if rollback_attempts <= args.rollback_retries:
+                # check_finite: a checkpoint saved AFTER the divergence is
+                # structurally valid but poisoned — roll past it to the last
+                # finite ("good") one
+                found, found_meta = resilience.find_latest_valid_checkpoint(
+                    out_file, log=print if is_root else None, check_finite=True
+                )
+            if found is None:
+                if is_root:
+                    why = ("rollback budget exhausted"
+                           if rollback_attempts > args.rollback_retries
+                           else "no valid checkpoint to roll back to")
+                    print(f"[resilience] {why} after {rb.reason} at step "
+                          f"{rb.step}; aborting with exit code "
+                          f"{resilience.EXIT_DIVERGED}", flush=True)
+                finish_telemetry()
+                raise SystemExit(resilience.EXIT_DIVERGED)
+            trees_rb, meta_rb = load_checkpoint(
+                found, allow_legacy_pickle=args.allow_legacy_pickle
             )
+            params_rb = dalle_mod.migrate_param_layout(trees_rb["weights"], dalle_cfg)
+            opt_rb = unflatten_like(state.opt_state, trees_rb["opt_state"])
+            state = TrainState(
+                state.step,
+                resilience.place_like(state.params, params_rb),
+                resilience.place_like(state.opt_state, opt_rb),
+            )
+            ds_rb = meta_rb.get("data_state") or {}
+            resume_epoch = ds_rb.get("epoch", meta_rb.get("epoch", 0))
+            pending_skip = ds_rb.get("epoch_batches", 0) or 0
+            global_step = meta_rb.get("global_step", 0) or 0
+            key = (resilience.decode_rng_key(ds_rb["rng_key"])
+                   if ds_rb.get("rng_key") is not None
+                   else jax.random.PRNGKey(args.seed + 1))
+            skip_pending.clear()
+            if health_monitor is not None:
+                health_monitor.load_state_dict(meta_rb.get("health_state"))
+            if is_root:
+                print(f"[resilience] rolled back to {found} (attempt "
+                      f"{rollback_attempts}/{args.rollback_retries}) after "
+                      f"{rb.reason} at step {rb.step}; resuming at step "
+                      f"{global_step}", flush=True)
 
         if save_here:
-            save(out_file, epoch + 1)
+            save(out_file, args.epochs, ds_epoch=args.epochs, ds_batches=0)
+            if writer is not None:
+                writer.flush()
             if is_root:
-                logger.log_artifact(out_file, name="trained-dalle", metadata=dalle_cfg.to_dict())
-
-    if save_here:
-        save(out_file, args.epochs)
-        if is_root:
-            logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
+                logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
+    finally:
+        shutdown.uninstall()
+        if injector is not None:
+            injector.uninstall()  # the global must not leak across main()s
+        if writer is not None:
+            writer.close()
     if tele is not None:
         tele.flush(logger, step=global_step)
         if is_root:

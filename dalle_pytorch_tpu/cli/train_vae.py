@@ -22,6 +22,7 @@ from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
+from dalle_pytorch_tpu.training import resilience
 from dalle_pytorch_tpu.training.checkpoint import save_checkpoint, to_host
 from dalle_pytorch_tpu.training.logging import MetricLogger
 from dalle_pytorch_tpu.version import __version__
@@ -74,16 +75,36 @@ def build_parser() -> argparse.ArgumentParser:
                              "norms, NaN/Inf localization, codebook usage/"
                              "perplexity, gumbel-temperature tracking, and "
                              "codebook-collapse alarms")
+    parser.add_argument("--resume", type=str, default=None, metavar="auto|PATH",
+                        help="'auto': if <vae_output_file_name>.pt exists and "
+                             "validates, restore its weights (and hparams) "
+                             "and continue — the flag a supervisor restarts "
+                             "with after a preemption (exit code 75); a path "
+                             "resumes from that checkpoint.  The optimizer "
+                             "state starts fresh (the VAE checkpoint stores "
+                             "weights only)")
+    parser.add_argument("--async_checkpoint", type=int, default=1,
+                        help="1 (default): serialize+fsync checkpoints on a "
+                             "background writer thread; 0: synchronous saves")
+    parser.add_argument("--inject_fault", type=str, default=None,
+                        metavar="KIND@STEP",
+                        help="fault-injection harness (tools/chaos.py); "
+                             "testing only")
     return backend_mod.wrap_arg_parser(parser)
 
 
-def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None):
-    save_checkpoint(
-        path,
-        trees={"weights": to_host(params)},
-        meta={"hparams": cfg.to_dict(), "version": __version__,
-              "health_state": health_state},
-    )
+def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None,
+               writer=None):
+    """Gather + write the VAE checkpoint.  With `writer` (an
+    AsyncCheckpointWriter) only the host gather runs here; serialization +
+    fsync + rename happen on the writer thread."""
+    trees = {"weights": to_host(params)}
+    meta = {"hparams": cfg.to_dict(), "version": __version__,
+            "health_state": health_state}
+    if writer is not None:
+        writer.submit(path, trees, meta)
+        return
+    save_checkpoint(path, trees, meta)
 
 
 def main(argv=None):
@@ -108,11 +129,39 @@ def main(argv=None):
         kl_div_loss_weight=args.kl_loss_weight,
     )
 
-    dataset = ImageDataset(args.image_folder, args.image_size, transparent=args.transparent)
+    # --resume: restore weights + hparams from a previous run's checkpoint
+    # (the supervisor-restart path after an exit-75 preemption).  'auto'
+    # quietly starts fresh when nothing resumable exists; a bad file fails
+    # with validate_checkpoint's distinct error.  Optimizer state starts
+    # fresh — the VAE checkpoint stores weights only.
+    resume_params = None
+    if args.resume is not None:
+        rpath = (f"{args.vae_output_file_name}.pt" if args.resume == "auto"
+                 else args.resume)
+        try:
+            meta = resilience.validate_checkpoint(rpath)
+        except resilience.CheckpointInvalidError as e:
+            if args.resume != "auto":
+                raise
+            meta = None
+            if is_root:
+                print(f"[resilience] --resume auto: {e}; starting fresh")
+        if meta is not None:
+            from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
+
+            trees, meta = load_checkpoint(rpath)
+            cfg = DiscreteVAEConfig(**meta["hparams"])
+            resume_params = jax.tree_util.tree_map(jnp.asarray, trees["weights"])
+            if is_root:
+                print(f"[resilience] resumed VAE weights from {rpath} "
+                      "(hparams taken from the checkpoint; fresh optimizer)")
+
+    dataset = ImageDataset(args.image_folder, cfg.image_size, transparent=args.transparent)
     assert len(dataset) > 0, f"no images found in {args.image_folder}"
     be.check_batch_size(args.batch_size)
 
-    params = vae_mod.init_discrete_vae(jax.random.PRNGKey(args.seed), cfg)
+    params = (resume_params if resume_params is not None
+              else vae_mod.init_discrete_vae(jax.random.PRNGKey(args.seed), cfg))
     # adam with the lr applied as a traced scalar inside the step, so the
     # per-epoch ExponentialLR decay (reference train_vae.py:157-158) never
     # triggers a recompile
@@ -190,111 +239,166 @@ def main(argv=None):
     def _health_state():
         return health_monitor.state_dict() if health_monitor is not None else None
 
-    # fail fast on unwritable output before burning compute
-    save_model(f"{args.vae_output_file_name}.pt", params, cfg)
+    out_file = f"{args.vae_output_file_name}.pt"
+    # async checkpoint writer + preemption-safe shutdown (training/resilience)
+    writer = resilience.AsyncCheckpointWriter() if args.async_checkpoint else None
+    shutdown = resilience.ShutdownHandler().install()
+    injector = None
+    if args.inject_fault is not None:
+        injector = resilience.FaultInjector(
+            resilience.parse_fault(args.inject_fault)
+        ).install()
+
+    # fail fast on unwritable output before burning compute (flushed through
+    # the async writer so the failure still lands before compilation)
+    save_model(out_file, params, cfg, writer=writer)
+    if writer is not None:
+        writer.flush()
+
+    def exit_preempted():
+        # counted here, not in the signal handler (registry locks are not
+        # signal-safe)
+        obs_metrics.counter("shutdown_requests").inc()
+        if is_root:
+            save_model(out_file, params, cfg, health_state=_health_state(),
+                       writer=writer)
+        if writer is not None:
+            writer.flush()
+        if is_root:
+            print(f"[resilience] preemption checkpoint written; exiting with "
+                  f"code {resilience.EXIT_PREEMPTED}", flush=True)
+        if tele is not None:
+            tele.flush(logger, step=global_step)
+            tele.close()
+        logger.finish()
+        # the SystemExit unwinds through the training loop's try/finally,
+        # which uninstalls the handlers and closes the writer
+        raise SystemExit(resilience.EXIT_PREEMPTED)
 
     temp = args.starting_temp
     global_step = 0
     key = jax.random.PRNGKey(args.seed + 1)
     compiled_variants = set()
     import contextlib as _ctx
-    for epoch in range(args.epochs):
-        t0 = time.time()
-        batches = iterate_image_batches(
-            dataset, args.batch_size, seed=args.seed + epoch,
-            process_index=be.get_rank(), process_count=be.get_world_size(),
-            num_workers=args.num_workers,
-        )
-        if args.prefetch_batches > 0:
-            batches = prefetch_to_device(batches, size=args.prefetch_batches)
-        batch_it = iter(batches)
-        while True:
-            if tele is not None:
-                tele.begin_step(global_step)
-            with telemetry.span("data_wait"):
-                images = next(batch_it, None)
-            if images is None:
+    try:
+        for epoch in range(args.epochs):
+            t0 = time.time()
+            batches = iterate_image_batches(
+                dataset, args.batch_size, seed=args.seed + epoch,
+                process_index=be.get_rank(), process_count=be.get_world_size(),
+                num_workers=args.num_workers,
+            )
+            if args.prefetch_batches > 0:
+                batches = prefetch_to_device(batches, size=args.prefetch_batches)
+            batch_it = iter(batches)
+            while True:
+                if injector is not None:
+                    injector.at_step(global_step)
                 if tele is not None:
-                    tele.abort_step()
-                break
-            key, sk = jax.random.split(key)
-            health_step = bool(args.health_every) and (
-                global_step % args.health_every == 0
-            )
-            # first post-arm dispatch of a new executable variant (plain vs
-            # diagnostic) legitimately compiles — shield it from the
-            # steady-state recompile alarm
-            new_variant = health_step not in compiled_variants
-            compiled_variants.add(health_step)
-            suspend = (
-                tele.compile_watcher.suspended()
-                if (new_variant and tele is not None
-                    and tele.compile_watcher is not None
-                    and tele.compile_watcher.armed)
-                else _ctx.nullcontext()
-            )
-            with telemetry.span("dispatch"), suspend:
-                params, opt_state, loss, health = train_step(
-                    params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr),
-                    with_health=health_step,
+                    tele.begin_step(global_step)
+                with telemetry.span("data_wait"):
+                    images = next(batch_it, None)
+                if images is None:
+                    if tele is not None:
+                        tele.abort_step()
+                    break
+                key, sk = jax.random.split(key)
+                health_step = bool(args.health_every) and (
+                    global_step % args.health_every == 0
                 )
-            if tele is not None and args.telemetry_sync:
-                with telemetry.span("block"):
-                    jax.block_until_ready(loss)
-            obs_metrics.counter("train_steps").inc()
-            if health_step:
-                with telemetry.span("health_publish"):
-                    health_mod.publish_and_observe(
-                        health, health_paths, health_monitor, global_step,
-                        tele=tele, registry=obs_metrics.REGISTRY,
-                        echo=print if is_root else None,
+                # first post-arm dispatch of a new executable variant (plain
+                # vs diagnostic) legitimately compiles — shield it from the
+                # steady-state recompile alarm
+                new_variant = health_step not in compiled_variants
+                compiled_variants.add(health_step)
+                suspend = (
+                    tele.compile_watcher.suspended()
+                    if (new_variant and tele is not None
+                        and tele.compile_watcher is not None
+                        and tele.compile_watcher.armed)
+                    else _ctx.nullcontext()
+                )
+                with telemetry.span("dispatch"), suspend:
+                    params, opt_state, loss, health = train_step(
+                        params, opt_state, jnp.asarray(images), sk, jnp.asarray(temp), jnp.asarray(lr),
+                        with_health=health_step,
                     )
+                if tele is not None and args.telemetry_sync:
+                    with telemetry.span("block"):
+                        jax.block_until_ready(loss)
+                obs_metrics.counter("train_steps").inc()
+                if health_step:
+                    with telemetry.span("health_publish"):
+                        health_mod.publish_and_observe(
+                            health, health_paths, health_monitor, global_step,
+                            tele=tele, registry=obs_metrics.REGISTRY,
+                            echo=print if is_root else None,
+                        )
 
-            if global_step % 100 == 0:
-                # temperature annealing (reference train_vae.py:276-278)
-                temp = max(temp * math.exp(-args.anneal_rate * global_step), args.temp_min)
-                idx = codebook_indices(params, jnp.asarray(images))
-                used = int(jnp.sum(jnp.bincount(idx.reshape(-1), length=cfg.num_tokens) > 0))
-                logger.log(
-                    {"loss": float(loss), "temperature": temp, "lr": lr,
-                     "codebook_used": used, "epoch": epoch},
-                    step=global_step,
-                )
-                if tele is not None:
-                    tele.flush(logger, step=global_step)
-                if is_root:
-                    # recon grids + hard recons + codebook histogram
-                    # (reference train_vae.py:252-271)
-                    k = min(args.num_images_save, images.shape[0])
-                    sample = jnp.asarray(images[:k])
-                    soft, hard = recon_pair(params, sample, sk, jnp.asarray(temp))
-                    logger.log_images(
-                        {
-                            "original images": sample,
-                            "reconstructions": denorm(soft),
-                            "hard reconstructions": denorm(hard),
-                        },
+                if global_step % 100 == 0:
+                    # temperature annealing (reference train_vae.py:276-278)
+                    temp = max(temp * math.exp(-args.anneal_rate * global_step), args.temp_min)
+                    idx = codebook_indices(params, jnp.asarray(images))
+                    used = int(jnp.sum(jnp.bincount(idx.reshape(-1), length=cfg.num_tokens) > 0))
+                    logger.log(
+                        {"loss": float(loss), "temperature": temp, "lr": lr,
+                         "codebook_used": used, "epoch": epoch},
                         step=global_step,
                     )
-                    logger.log_histogram("codebook_indices", idx, step=global_step)
-            if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
-                t0 = time.perf_counter()
-                with telemetry.span("checkpoint"):
-                    save_model(f"{args.vae_output_file_name}.pt", params, cfg,
-                               health_state=_health_state())
-                obs_metrics.histogram("checkpoint_save_s").observe(
-                    time.perf_counter() - t0
-                )
-            if tele is not None:
-                tele.finish_step(global_step)
-            global_step += 1
+                    if tele is not None:
+                        tele.flush(logger, step=global_step)
+                    if is_root:
+                        # recon grids + hard recons + codebook histogram
+                        # (reference train_vae.py:252-271)
+                        k = min(args.num_images_save, images.shape[0])
+                        sample = jnp.asarray(images[:k])
+                        soft, hard = recon_pair(params, sample, sk, jnp.asarray(temp))
+                        logger.log_images(
+                            {
+                                "original images": sample,
+                                "reconstructions": denorm(soft),
+                                "hard reconstructions": denorm(hard),
+                            },
+                            step=global_step,
+                        )
+                        logger.log_histogram("codebook_indices", idx, step=global_step)
+                if global_step and args.save_every_n_steps and global_step % args.save_every_n_steps == 0 and is_root:
+                    # NB: not `t0` — that's the epoch wall-clock timer, and
+                    # shadowing it here corrupted epoch_time_s
+                    t_save = time.perf_counter()
+                    with telemetry.span("checkpoint"):
+                        # async writer: the span covers only the host gather
+                        # + enqueue; serialize/fsync run on the writer thread
+                        save_model(out_file, params, cfg,
+                                   health_state=_health_state(), writer=writer)
+                    obs_metrics.histogram("checkpoint_save_s").observe(
+                        time.perf_counter() - t_save
+                    )
+                    if injector is not None and injector.wants_checkpoint_fault():
+                        if writer is not None:
+                            writer.flush()
+                        injector.after_checkpoint(out_file, global_step)
+                if tele is not None:
+                    tele.finish_step(global_step)
+                if shutdown.requested:
+                    # the in-flight step finished; leave cleanly with an
+                    # emergency checkpoint (exit 75 — supervisor restarts)
+                    exit_preempted()
+                global_step += 1
 
-        lr *= args.lr_decay_rate
-        if is_root:
-            save_model(f"{args.vae_output_file_name}.pt", params, cfg,
-                       health_state=_health_state())
-            logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
-
+            lr *= args.lr_decay_rate
+            if is_root:
+                save_model(out_file, params, cfg,
+                           health_state=_health_state(), writer=writer)
+                logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
+    finally:
+        # an exception mid-training must still drain queued async saves
+        # (and surface their write errors) and restore the signal handlers
+        shutdown.uninstall()
+        if injector is not None:
+            injector.uninstall()  # the global must not leak across main()s
+        if writer is not None:
+            writer.close()
     if tele is not None:
         tele.flush(logger, step=global_step)
         tele.close()

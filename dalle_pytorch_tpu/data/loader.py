@@ -184,13 +184,20 @@ def iterate_batches(
     drop_last: bool = True,
     epochs: Optional[int] = 1,
     num_workers: int = 0,
+    skip_batches: int = 0,
 ) -> Iterator[dict]:
     """Batches as {'text': (B, text_len) int64, 'image': (B, H, W, C) f32}.
     Indices are sharded across processes (DistributedSampler equivalent).
     num_workers > 0 decodes/crops samples on a thread pool; per-item rngs
-    keep the output bit-identical to the serial path."""
+    keep the output bit-identical to the serial path.
+
+    skip_batches fast-forwards past the first N batches of the FIRST epoch
+    without decoding them (the index array is sliced before any I/O) — the
+    exact-resume cursor: a run restored mid-epoch continues with batch N
+    bit-identical to what an uninterrupted run would have produced."""
     n = len(dataset)
     epoch = 0
+    skip = max(skip_batches, 0)
     while epochs is None or epoch < epochs:
         order = np.arange(n)
         if shuffle:
@@ -198,6 +205,9 @@ def iterate_batches(
         order = order[process_index::process_count]
         usable = len(order) - (len(order) % batch_size if drop_last else 0)
         order = order[:usable]
+        if skip:
+            order = order[skip * batch_size:]
+            skip = 0
         if not len(order):
             epoch += 1
             continue
@@ -374,39 +384,127 @@ def expand_shard_spec(spec: str) -> List[str]:
     return [e for p in parts for e in expand_shard_spec(head + p + tail)]
 
 
+def _urlopen_retry(url: str, retries: int, timeout: float, offset: int = 0):
+    """urllib open with bounded retries + backoff.  offset > 0 adds an HTTP
+    `Range: bytes={offset}-` header (the mid-stream reconnect path); when
+    the server ignores Range (200 instead of 206), the prefix is read and
+    discarded so the caller still resumes at the right byte."""
+    import urllib.error
+    import urllib.request
+
+    last: Optional[Exception] = None
+    attempts = max(retries, 1)
+    for attempt in range(attempts):
+        try:
+            req = urllib.request.Request(url)
+            if offset:
+                req.add_header("Range", f"bytes={offset}-")
+            resp = urllib.request.urlopen(req, timeout=timeout)
+            if offset and getattr(resp, "getcode", lambda: 206)() == 200:
+                # no Range support: fast-forward by discarding the prefix
+                left = offset
+                while left > 0:
+                    chunk = resp.read(min(left, 1 << 20))
+                    if not chunk:
+                        break
+                    left -= len(chunk)
+            return resp
+        except Exception as e:  # noqa: BLE001 — retry most transport errors
+            # EXCEPT permanent 4xx: the server is saying the REQUEST is
+            # wrong (404 from a typo'd shard prefix, 403 from missing
+            # auth) — retrying cannot succeed and turns a fail-fast into
+            # minutes of backoff per shard.  408 (request timeout) and
+            # 429 (rate limit) are the transient 4xx exceptions; 5xx is
+            # server-side and retried like any transport error.  416 on a
+            # Range reconnect means the stream ended exactly at offset —
+            # the caller treats it as EOF.
+            if (isinstance(e, urllib.error.HTTPError)
+                    and 400 <= e.code < 500 and e.code not in (408, 429)):
+                raise
+            last = e
+            if attempt < attempts - 1:  # no pointless backoff after the last try
+                import time
+
+                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+    raise last
+
+
+class _ResumingHTTPStream:
+    """File-like over http(s) that survives mid-stream disconnects: a failed
+    read re-opens the URL with a Range request from the current byte offset
+    (bounded by the same retry budget as the initial open) instead of
+    aborting the whole shard — a multi-GB shard 90% downloaded no longer
+    restarts from zero on one TCP reset.  Reconnects are counted in the
+    metrics registry (`data_stream_reconnects`)."""
+
+    def __init__(self, url: str, retries: int, timeout: float):
+        self._url = url
+        self._retries = retries
+        self._timeout = timeout
+        self._resp = _urlopen_retry(url, retries, timeout)
+        self._pos = 0
+        self._reconnects = 0
+        self._eof = False
+
+    def _chaos_drop(self) -> bool:
+        # fault-injection seam (--inject_fault drop-remote-stream)
+        from dalle_pytorch_tpu.training.resilience import take_stream_fault
+
+        return take_stream_fault()
+
+    def read(self, n: int = -1) -> bytes:
+        while True:
+            if self._eof:
+                return b""
+            try:
+                if self._chaos_drop():
+                    raise OSError("injected mid-stream disconnect (chaos)")
+                chunk = self._resp.read(n)
+            except Exception as e:  # noqa: BLE001 — reconnect w/ Range
+                self._reconnect(e)
+                continue
+            # budget is PER INCIDENT: a successful read means the last
+            # reconnect made progress, so independent transient resets hours
+            # apart each get the full retry budget (a lifetime cap would
+            # abandon a long stream after N spread-out blips)
+            self._reconnects = 0
+            self._pos += len(chunk)
+            return chunk
+
+    def _reconnect(self, err: Exception) -> None:
+        import urllib.error
+
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._reconnects += 1
+        if self._reconnects > max(self._retries, 1):
+            raise err
+        _counter("data_stream_reconnects").inc()
+        try:
+            self._resp = _urlopen_retry(
+                self._url, self._retries, self._timeout, offset=self._pos
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 416:  # stream ended exactly at our offset
+                self._eof = True
+                return
+            raise
+
+    def close(self) -> None:
+        self._resp.close()
+
+
 def _open_remote(url: str, retries: int, timeout: float):
     """File-like stream for one remote shard.  http(s) via urllib with
-    bounded retries + backoff; gs:// via a `gsutil cat` pipe (the tool the
+    bounded retries + backoff AND mid-stream Range-request resume
+    (_ResumingHTTPStream); gs:// via a `gsutil cat` pipe (the tool the
     reference's `pipe:gsutil cat {url} || true` wds spec shells out to,
     /root/reference/train_dalle.py:218).  Raises on final failure — the
     caller's handler absorbs it (warn-and-continue)."""
     if url.startswith(("http://", "https://")):
-        import urllib.error
-        import urllib.request
-
-        last: Optional[Exception] = None
-        attempts = max(retries, 1)
-        for attempt in range(attempts):
-            try:
-                return urllib.request.urlopen(
-                    urllib.request.Request(url), timeout=timeout
-                )
-            except Exception as e:  # noqa: BLE001 — retry most transport errors
-                # EXCEPT permanent 4xx: the server is saying the REQUEST is
-                # wrong (404 from a typo'd shard prefix, 403 from missing
-                # auth) — retrying cannot succeed and turns a fail-fast into
-                # minutes of backoff per shard.  408 (request timeout) and
-                # 429 (rate limit) are the transient 4xx exceptions; 5xx is
-                # server-side and retried like any transport error.
-                if (isinstance(e, urllib.error.HTTPError)
-                        and 400 <= e.code < 500 and e.code not in (408, 429)):
-                    raise
-                last = e
-                if attempt < attempts - 1:  # no pointless backoff after the last try
-                    import time
-
-                    time.sleep(min(2.0 ** attempt * 0.1, 5.0))
-        raise last
+        return _ResumingHTTPStream(url, retries, timeout)
     if url.startswith("gs://"):
         import subprocess
 

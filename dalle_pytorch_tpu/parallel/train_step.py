@@ -24,6 +24,7 @@ from dalle_pytorch_tpu.core.pytree import cast_floating
 from dalle_pytorch_tpu.observability import health as health_mod
 from dalle_pytorch_tpu.parallel.mesh import BATCH_AXES
 from dalle_pytorch_tpu.parallel.sharding import opt_state_specs, param_specs
+from dalle_pytorch_tpu.training.resilience import nonfinite_guard
 
 P = PartitionSpec
 
@@ -72,6 +73,12 @@ class StepSettings:
     # "dynamic" = DeepSpeed-style dynamic scaling (start 2^15, halve on
     # nonfinite grads + skip the step, double after 2000 clean steps).
     loss_scale: Optional[Any] = None
+    # Bad-step guard (training/resilience.py): skip the optimizer update
+    # when the gradient norm is non-finite, so one poisoned batch cannot
+    # write NaN into params and moments.  Previously this protection existed
+    # only under loss_scale; None (default) enables it for every run —
+    # bf16-without-scaling included.  False restores the unguarded update.
+    skip_nonfinite: Optional[bool] = None
 
 
 def _stochastic_round(x32: jnp.ndarray, key: jax.Array, dtype) -> jnp.ndarray:
@@ -298,26 +305,34 @@ def make_train_step(
                 params = optax.apply_updates(params, updates)
             return params, opt_state
 
+        # bad-step guard (training/resilience.py): a nonfinite gradient
+        # skips the update entirely — always on under loss scaling (the
+        # fp16 overflow-skip semantics), and by default for every other run
+        # too, so one poisoned batch cannot write NaN into params/moments
+        guarded = ls_enabled or settings.skip_nonfinite is not False
+        if guarded:
+            finite = jnp.isfinite(gnorm)
+            params, opt_state = nonfinite_guard(
+                do_update, grads, inner_opt_state, state.params, round_key, finite
+            )
+        else:
+            finite = None
+            params, opt_state = do_update(
+                grads, inner_opt_state, state.params, round_key
+            )
+
         if not ls_enabled:
-            params, opt_state = do_update(grads, inner_opt_state, state.params, round_key)
             new_state = TrainState(state.step + 1, params, opt_state)
             metrics = {"loss": loss, "grad_norm": gnorm}
+            if guarded:
+                metrics["skipped"] = (~finite).astype(jnp.int32)
             if with_health:
                 metrics["health"] = _health_outputs(
                     state, batch, key, grads, loss, params
                 )
             return new_state, metrics
 
-        # fp16-style overflow handling: a nonfinite gradient skips the step
-        # entirely and halves the scale; clean steps grow it back (dynamic)
-        finite = jnp.isfinite(gnorm)
-        args_ = (grads, inner_opt_state, state.params, round_key)
-        params, opt_state = jax.lax.cond(
-            finite,
-            lambda a: do_update(a[0], a[1], a[2], a[3]),
-            lambda a: (a[2], a[1]),
-            args_,
-        )
+        # loss-scale bookkeeping: halve on overflow, grow back on clean steps
         if ls_dynamic:
             good = jnp.where(finite, ls["good_steps"] + 1, 0)
             grow = good >= LS_GROWTH_INTERVAL

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -218,10 +219,26 @@ def save_checkpoint(path: str, trees: Dict[str, Any], meta: Dict[str, Any]) -> N
         )
     path = str(path)
     tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
+        # flush + fsync BEFORE the rename: os.replace only reorders the
+        # directory entry — without fsync a host crash right after rotation
+        # deleted the old checkpoints could leave the "new" one as zero
+        # durable bytes, i.e. NO valid checkpoint at all
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:
+        # fsync the directory so the rename itself survives a crash
+        dirfd = os.open(parent, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:  # pragma: no cover — platforms without dir fsync
+        pass
 
 
 def _load_leaves(data, name: str, n: int) -> List[np.ndarray]:
@@ -297,13 +314,36 @@ def load_checkpoint(
     return trees, meta
 
 
+# the `<name>_step<N>[.ext]` checkpoint naming convention, shared by
+# rotation ordering (below) and resume discovery (training/resilience.py) —
+# one regex so the two can never rank different file sets
+STEP_FILENAME_RE = re.compile(r"_step(\d+)(?:\.[^.]*)?$")
+
+
 def rotate_checkpoints(directory: str, pattern: str, keep_n: Optional[int]) -> None:
     """Delete the oldest checkpoints matching `pattern` (a glob) so at most
-    keep_n remain.  Handles both single-file (npz) and directory (orbax
-    sharded) checkpoints."""
+    keep_n remain.  "Oldest" is the step number parsed from the FILENAME —
+    st_mtime lies under clock skew, `cp` restores, or NFS, and evicting the
+    newest checkpoint on a skewed clock would destroy the resume point.
+    Files without a parseable step fall back to mtime order (below every
+    stepped file).  In-progress `*.tmp` writes are never matched or
+    deleted.  Handles both single-file (npz) and directory (orbax sharded)
+    checkpoints."""
     if keep_n is None or keep_n <= 0:
         return
-    files = sorted(Path(directory).glob(pattern), key=lambda p: p.stat().st_mtime)
+
+    def key(p: Path):
+        m = STEP_FILENAME_RE.search(p.name)
+        return (
+            (1, int(m.group(1)), 0.0) if m
+            else (0, 0, p.stat().st_mtime)
+        )
+
+    files = sorted(
+        (p for p in Path(directory).glob(pattern)
+         if not p.name.endswith(".tmp")),
+        key=key,
+    )
     for old in files[:-keep_n]:
         if old.is_dir():
             import shutil

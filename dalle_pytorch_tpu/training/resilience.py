@@ -1,0 +1,657 @@
+"""Fault-tolerant training: the layer that *survives* the failures the
+observability stack (PR 1/2) can see.
+
+Five cooperating pieces, wired through both training CLIs:
+
+* **Preemption-safe shutdown** (`ShutdownHandler`) — SIGTERM/SIGINT set a
+  flag; the training loop finishes the in-flight step, writes an emergency
+  checkpoint, and exits with `EXIT_PREEMPTED` so an outer supervisor can
+  auto-restart with `--resume auto`.  A second signal aborts immediately.
+* **Async checkpointing** (`AsyncCheckpointWriter`) — the device→host gather
+  stays synchronous (it must read a consistent state), but serialization +
+  fsync + atomic rename + rotation run on a background writer thread with a
+  bounded queue, so `save_every_n_steps` no longer stalls the step loop.
+* **Exact resume** — checkpoint meta carries a `data_state` (epoch,
+  within-epoch batch cursor, shuffle seed, RNG key) so a resumed run
+  continues mid-epoch batch-for-batch instead of replaying the epoch;
+  `find_latest_valid_checkpoint` implements `--resume auto`: newest step
+  file first, validated (`validate_checkpoint`), falling back past
+  truncated/corrupt/future-format files.
+* **Bad-step guard** (`nonfinite_guard`) — the in-graph skip-poisoned-update
+  cond, factored out of the loss-scale path so bf16-without-scaling runs
+  skip too.  This function is jit-pure and traced inside the train step;
+  the module is covered by tools/lint_host_sync.py, with the few deliberate
+  host-side file/PRNG operations waived line-by-line.
+* **Fault injection** (`FaultInjector`, `parse_fault`) — `--inject_fault
+  KIND@STEP` drives kill/preempt/corrupt/truncate/stall/drop faults for the
+  crash-and-resume equivalence tests (tests/test_resilience.py) and
+  tools/chaos.py.
+
+Exit codes (for supervisors):
+  EXIT_PREEMPTED (75) — graceful preemption; restart with `--resume auto`.
+  EXIT_DIVERGED  (76) — rollback budget exhausted; do NOT auto-restart.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import signal
+import threading
+import time
+import zipfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.observability import counter as _counter
+from dalle_pytorch_tpu.observability import histogram as _histogram
+from dalle_pytorch_tpu.training import checkpoint as checkpoint_mod
+
+__all__ = [
+    "EXIT_DIVERGED",
+    "EXIT_PREEMPTED",
+    "AsyncCheckpointWriter",
+    "CheckpointInvalidError",
+    "CheckpointMetaError",
+    "Fault",
+    "FaultInjector",
+    "FutureFormatError",
+    "MissingLeavesError",
+    "NonFiniteCheckpointError",
+    "RollbackRequested",
+    "ShutdownHandler",
+    "TruncatedCheckpointError",
+    "checkpoint_candidates",
+    "corrupt_file",
+    "data_state_dict",
+    "decode_rng_key",
+    "encode_rng_key",
+    "find_latest_valid_checkpoint",
+    "nonfinite_guard",
+    "parse_fault",
+    "place_like",
+    "take_stream_fault",
+    "truncate_file",
+    "validate_checkpoint",
+]
+
+# sysexits-adjacent, and far from the 1/2 python uses for crashes: a
+# supervisor can `while run; rc=$?; [ $rc -eq 75 ] || break; done`
+EXIT_PREEMPTED = 75  # graceful preemption — safe to auto-restart
+EXIT_DIVERGED = 76   # rollback budget exhausted — needs a human
+
+
+# ---------------------------------------------------------------------------
+# in-graph half: the bad-step guard (jit-pure — traced inside the train step)
+# ---------------------------------------------------------------------------
+
+def nonfinite_guard(update_fn, grads, opt_state, params, round_key, finite):
+    """Apply `update_fn(grads, opt_state, params, round_key)` only when
+    `finite` (a traced bool scalar, e.g. isfinite(grad_norm)) holds;
+    otherwise return the state untouched — a poisoned gradient skips the
+    update entirely instead of writing NaN into params and moments.
+
+    Factored out of the loss-scale overflow path (parallel/train_step.py) so
+    bf16-without-scaling runs get the same protection.  Jit-pure: one
+    lax.cond, no host syncs."""
+    return jax.lax.cond(
+        finite,
+        lambda a: update_fn(a[0], a[1], a[2], a[3]),
+        lambda a: (a[2], a[1]),
+        (grads, opt_state, params, round_key),
+    )
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+class ShutdownHandler:
+    """SIGTERM/SIGINT → request a graceful stop.
+
+    The first signal only sets `.requested`; the training loop checks it
+    after each completed step, writes an emergency checkpoint, and exits
+    with EXIT_PREEMPTED.  A second signal raises KeyboardInterrupt so a
+    wedged run can still be killed from the keyboard.  `install()` is a
+    no-op off the main thread (signal handlers are main-thread-only)."""
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._prev: Dict[int, Any] = {}
+        self._installed = False
+        self.requested = False
+        self.signum: Optional[int] = None
+
+    def install(self) -> "ShutdownHandler":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal would raise; run unprotected
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        if self.requested:
+            # second signal: the operator really means it
+            raise KeyboardInterrupt(
+                f"second signal {signum} during graceful shutdown"
+            )
+        # flag-only: the handler can interrupt the main thread while it
+        # holds the metrics-registry lock, so touching any instrument here
+        # (a non-reentrant shared lock) could self-deadlock and wedge the
+        # very shutdown path this exists for.  The training loop counts the
+        # request when it observes the flag.
+        self.requested = True
+        self.signum = signum
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint writer
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _SaveJob:
+    path: str
+    trees: Dict[str, Any]
+    meta: Dict[str, Any]
+    keep_n: Optional[int]
+    rotation_glob: Optional[str]
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint serializer.
+
+    `submit()` returns as soon as the job is queued — the caller has already
+    gathered the trees to host (a consistent snapshot), and serialization +
+    fsync + atomic rename + rotation happen on the writer thread.  The queue
+    is bounded (`max_pending`): if saves are submitted faster than the disk
+    drains them, submit blocks (back-pressure) instead of buying unbounded
+    host memory.  A write failure is remembered and re-raised on the next
+    `submit()`/`flush()`/`close()` — a run must not silently train past a
+    dead output disk.  `flush()` blocks until everything queued is durable
+    (used before rollback reloads, emergency exits, and artifact logging)."""
+
+    def __init__(self, max_pending: int = 2, save_fn=None, rotate_fn=None):
+        self._save = save_fn or checkpoint_mod.save_checkpoint
+        self._rotate = rotate_fn or checkpoint_mod.rotate_checkpoints
+        self._q: "queue.Queue[Optional[_SaveJob]]" = queue.Queue(
+            maxsize=max(1, max_pending)
+        )
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._last_completed: Optional[str] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                t0 = time.perf_counter()
+                self._save(job.path, job.trees, job.meta)
+                if job.keep_n and job.rotation_glob:
+                    self._rotate(
+                        str(Path(job.path).parent), job.rotation_glob, job.keep_n
+                    )
+                _histogram("checkpoint_write_s").observe(time.perf_counter() - t0)
+                _counter("checkpoints_saved").inc()
+                with self._lock:
+                    self._last_completed = job.path
+            except BaseException as e:  # noqa: BLE001 — surfaced on next call
+                with self._lock:
+                    self._error = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(f"async checkpoint write failed: {err!r}") from err
+
+    @property
+    def last_completed(self) -> Optional[str]:
+        with self._lock:
+            return self._last_completed
+
+    def submit(self, path: str, trees: Dict[str, Any], meta: Dict[str, Any],
+               keep_n: Optional[int] = None,
+               rotation_glob: Optional[str] = None) -> None:
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        self._raise_pending()
+        self._q.put(_SaveJob(str(path), trees, meta, keep_n, rotation_glob))
+
+    def flush(self) -> None:
+        """Block until every queued save is durable; raise any write error."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+        self._raise_pending()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint validation + auto-resume discovery
+# ---------------------------------------------------------------------------
+
+class CheckpointInvalidError(ValueError):
+    """Base: this file cannot be resumed from (each subclass says why and
+    what to do).  `--resume auto` falls back past any of these."""
+
+
+class TruncatedCheckpointError(CheckpointInvalidError):
+    """The file is not a readable npz archive — a crash mid-write or a
+    truncated copy.  Delete it; resume from the previous checkpoint."""
+
+
+class CheckpointMetaError(CheckpointInvalidError):
+    """`__meta` (or a structure record) is missing or not valid JSON — the
+    payload bytes were corrupted.  Resume from the previous checkpoint."""
+
+
+class MissingLeavesError(CheckpointInvalidError):
+    """The leaf manifest names arrays the archive does not contain — a
+    partial write.  Resume from the previous checkpoint."""
+
+
+class FutureFormatError(CheckpointInvalidError):
+    """The file's `__format` is newer than this loader — upgrade the library
+    to read it (refusing beats mis-reading bit-views)."""
+
+
+class NonFiniteCheckpointError(CheckpointInvalidError):
+    """A float leaf contains NaN/Inf — structurally sound but poisoned (e.g.
+    saved after a divergence).  The rollback path skips these; resume from
+    an earlier finite checkpoint."""
+
+
+def validate_checkpoint(path: str, check_finite: bool = False) -> Dict[str, Any]:
+    """Cheap structural validation of an npz checkpoint WITHOUT loading the
+    arrays: the zip archive opens, `__format` is readable by this loader,
+    `__meta` parses as a JSON object, and every leaf named by each tree's
+    `__paths_` manifest is present.  Returns the parsed meta.  Raises a
+    distinct `CheckpointInvalidError` subclass per failure mode so logs say
+    what actually happened (and `--resume auto` can fall back).
+
+    check_finite=True additionally reads every float leaf — low-precision
+    (bf16) leaves are viewed back through the dtype sidecar first — and
+    rejects NaN/Inf (NonFiniteCheckpointError): the ROLLBACK screen, which
+    must not land on a checkpoint saved after the divergence it is rolling
+    back from.  (Costs a full file read.)"""
+    import numpy as np
+
+    p = Path(path)
+    if not p.is_file():
+        raise TruncatedCheckpointError(f"checkpoint {path!r} does not exist")
+    try:
+        data = np.load(str(p), allow_pickle=False)
+    except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+        raise TruncatedCheckpointError(
+            f"checkpoint {path!r} is not a readable npz archive (truncated "
+            f"write or corrupt copy): {e!r}"
+        ) from e
+    with data:
+        files = set(data.files)
+
+        def _read_json(key: str, err_cls):
+            try:
+                return json.loads(bytes(data[key]).decode())
+            except Exception as e:  # zip CRC, unicode, json — all corruption
+                raise err_cls(
+                    f"checkpoint {path!r}: {key} is unreadable or not valid "
+                    f"JSON ({e!r}) — the payload bytes were corrupted"
+                ) from e
+
+        if "__format" in files:
+            try:
+                fmt = data["__format"]
+            except Exception as e:
+                raise TruncatedCheckpointError(
+                    f"checkpoint {path!r}: __format member unreadable: {e!r}"
+                ) from e
+            if fmt > checkpoint_mod.FORMAT_VERSION:
+                raise FutureFormatError(
+                    f"checkpoint {path!r} has format version {fmt}, newer "
+                    f"than this loader's {checkpoint_mod.FORMAT_VERSION}; "
+                    "upgrade the library to read it"
+                )
+        if "__meta" not in files:
+            raise CheckpointMetaError(
+                f"checkpoint {path!r} has no __meta record"
+            )
+        meta = _read_json("__meta", CheckpointMetaError)
+        if not isinstance(meta, dict):
+            raise CheckpointMetaError(
+                f"checkpoint {path!r}: __meta is {type(meta).__name__}, "
+                "expected a JSON object"
+            )
+        for key in sorted(files):
+            if not key.startswith("__paths_"):
+                continue
+            name = key[len("__paths_"):]
+            paths = _read_json(key, CheckpointMetaError)
+            missing = [
+                f"{name}:{i}" for i in range(len(paths))
+                if f"{name}:{i}" not in files
+            ]
+            if missing:
+                raise MissingLeavesError(
+                    f"checkpoint {path!r}: tree {name!r} manifest lists "
+                    f"{len(paths)} leaves but {len(missing)} are absent "
+                    f"(first: {missing[0]}) — partial write"
+                )
+        if check_finite:
+            import numpy as np
+
+            # per-tree dtype sidecars: low-precision leaves (bf16 param
+            # storage) are stored as uint bit-views and must be viewed back
+            # before the isfinite screen — a NaN bf16 weight is NOT finite
+            dtypes: Dict[str, List[str]] = {}
+            for key in files:
+                if key.startswith("__dtypes_"):
+                    dtypes[key[len("__dtypes_"):]] = _read_json(
+                        key, CheckpointMetaError
+                    )
+            for key in sorted(files):
+                if key.startswith("__") or ":" not in key:
+                    continue
+                try:
+                    leaf = data[key]
+                except Exception as e:
+                    raise TruncatedCheckpointError(
+                        f"checkpoint {path!r}: leaf {key} unreadable: {e!r}"
+                    ) from e
+                name, _, idx = key.rpartition(":")
+                want = None
+                tree_dtypes = dtypes.get(name)
+                if tree_dtypes is not None and idx.isdigit():
+                    i = int(idx)  # host-sync-ok: parsing an npz key string
+                    if i < len(tree_dtypes):
+                        want = tree_dtypes[i]
+                if want is not None and leaf.dtype.name != want:
+                    try:
+                        leaf = leaf.view(checkpoint_mod._lowp_dtype(want))
+                    except (TypeError, ValueError):  # unknown sidecar dtype
+                        continue
+                if (jnp.issubdtype(leaf.dtype, jnp.floating)
+                        and not np.isfinite(
+                            leaf.astype(np.float32, copy=False)).all()):
+                    raise NonFiniteCheckpointError(
+                        f"checkpoint {path!r}: leaf {key} contains NaN/Inf "
+                        "— saved after a divergence; roll back further"
+                    )
+    return meta
+
+
+# the same `_step<N>` filename convention rotation orders by — one regex
+# (checkpoint.STEP_FILENAME_RE) so rotation and discovery can't drift
+_STEP_FILE_RE = checkpoint_mod.STEP_FILENAME_RE
+
+
+def _peek_global_step(path: Path) -> Optional[int]:
+    """Best-effort read of just the `__meta` global_step (one small zip
+    member) — used to RANK resume candidates; never trusted as validation."""
+    import numpy as np
+
+    try:
+        with np.load(str(path), allow_pickle=False) as data:
+            meta = json.loads(bytes(data["__meta"]).decode())
+        step = meta.get("global_step")
+        return step if isinstance(step, int) else None
+    except Exception:  # noqa: BLE001 — corrupt files rank by filename only
+        return None
+
+
+def checkpoint_candidates(output_path: str) -> List[Path]:
+    """Resume candidates for a run whose main output file is `output_path`
+    (`<dir>/<name>.pt`): the `<name>_step<N>.*` files plus the epoch-end
+    `<name>.pt` itself, newest-first.  Ranking reads each file's meta
+    `global_step` when possible — the epoch-end file can be strictly newer
+    than every step file — and falls back to the step parsed from the
+    FILENAME (mtime lies under clock skew / copies; a step file's meta step
+    is filename step + 1, so the two scales agree).  In-progress `*.tmp`
+    files never qualify."""
+    out = Path(output_path)
+    ranked: List[Tuple[int, int, int, Path]] = []
+    for p in out.parent.glob(f"{out.stem}_step*"):
+        if p.name.endswith(".tmp") or p.is_dir():
+            continue
+        m = _STEP_FILE_RE.search(p.name)
+        if not m:
+            continue
+        fname_step = int(m.group(1))
+        step = _peek_global_step(p)
+        # ties: prefer a step file over the epoch-end file (its filename
+        # commits to the position), then the higher filename step
+        ranked.append(
+            (step if step is not None else fname_step + 1, 1, fname_step, p)
+        )
+    if out.is_file():
+        step = _peek_global_step(out)
+        ranked.append((step if step is not None else -1, 0, -1, out))
+    ranked.sort(key=lambda t: t[:3], reverse=True)
+    return [p for *_, p in ranked]
+
+
+def find_latest_valid_checkpoint(
+    output_path: str, log=None, check_finite: bool = False
+) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
+    """`--resume auto`: newest candidate that validates; invalid ones are
+    reported (and counted) and fallen past.  Returns (path, meta) or
+    (None, None) when nothing resumable exists.  check_finite=True is the
+    rollback screen (skip NaN-poisoned saves; costs a full read per
+    candidate)."""
+    for p in checkpoint_candidates(output_path):
+        try:
+            meta = validate_checkpoint(str(p), check_finite=check_finite)
+            return str(p), meta
+        except CheckpointInvalidError as e:
+            _counter("resume_candidates_rejected").inc()
+            if log is not None:
+                log(f"[resilience] skipping unusable checkpoint: {e}")
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# exact-resume data state
+# ---------------------------------------------------------------------------
+
+def encode_rng_key(key) -> List[int]:
+    """Checkpoint-time snapshot of the training loop's PRNG key (the 2-word
+    uint32 key array) as a JSON-ready list."""
+    # host-sync-ok: deliberate checkpoint-time fetch of an 8-byte key
+    return [int(x) for x in jax.device_get(key).reshape(-1)]
+
+
+def decode_rng_key(words: List[int]):
+    return jnp.asarray(words, dtype=jnp.uint32)
+
+
+def data_state_dict(epoch: int, epoch_batches: int, seed: int,
+                    rng_key=None) -> Dict[str, Any]:
+    """The `data_state` checkpoint meta record: everything a resume needs to
+    continue mid-epoch batch-for-batch — which epoch, how many batches of it
+    were already consumed (the fast-forward cursor for
+    `iterate_batches(skip_batches=...)`), the shuffle seed that ordered
+    them, and the loop's PRNG key."""
+    ds: Dict[str, Any] = {
+        "epoch": epoch,
+        "epoch_batches": epoch_batches,
+        "seed": seed,
+    }
+    if rng_key is not None:
+        ds["rng_key"] = encode_rng_key(rng_key)
+    return ds
+
+
+def place_like(current: Any, saved: Any) -> Any:
+    """Restore `saved` (host arrays, same structure as `current`) onto
+    `current`'s devices/shardings/dtypes — the rollback reload path, which
+    must land the arrays exactly where the live TrainState keeps them."""
+    def _leaf(cur, new):
+        if hasattr(cur, "sharding") and hasattr(cur, "dtype"):
+            return jax.device_put(
+                jnp.asarray(new).astype(cur.dtype), cur.sharding
+            )
+        return new
+
+    return jax.tree_util.tree_map(_leaf, current, saved)
+
+
+class RollbackRequested(Exception):
+    """Raised inside the training loop when a sustained-nonfinite alarm asks
+    for a rollback to the last good checkpoint; caught by the retry wrapper
+    around the epoch loop."""
+
+    def __init__(self, step: int, reason: str):
+        super().__init__(f"rollback requested at step {step}: {reason}")
+        self.step = step
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# fault injection (tools/chaos.py is the CLI wrapper)
+# ---------------------------------------------------------------------------
+
+FAULT_KINDS = (
+    "kill-process",       # SIGKILL self at step N (hard crash — no cleanup)
+    "preempt",            # SIGTERM self at step N (graceful-shutdown path)
+    "corrupt-checkpoint",  # garbage bytes into the checkpoint saved at/after N
+    "truncate-checkpoint",  # cut the checkpoint saved at/after N in half
+    "stall-data",         # sleep the data path at step N (hang-monitor food)
+    "drop-remote-stream",  # sever a remote shard stream mid-read once
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    step: int
+    stall_s: float = 5.0
+
+
+def parse_fault(spec: str) -> Fault:
+    """`KIND@STEP` (e.g. `kill-process@40`); STEP defaults to 0.  stall-data
+    accepts `stall-data@STEP:SECONDS`."""
+    kind, _, at = spec.partition("@")
+    if kind not in FAULT_KINDS:
+        raise ValueError(
+            f"unknown fault kind {kind!r}; choose from {', '.join(FAULT_KINDS)}"
+        )
+    stall_s = 5.0
+    if ":" in at:
+        at, _, secs = at.partition(":")
+        stall_s = float(secs)  # host-sync-ok: parsing a CLI flag string
+    return Fault(kind, int(at or 0), stall_s)
+
+
+_ACTIVE_INJECTOR: Optional["FaultInjector"] = None
+
+
+class FaultInjector:
+    """Process-global fault driver for `--inject_fault`.  The training loop
+    calls `at_step(step)` at the top of every step and `after_checkpoint(
+    path, step)` after a durable save; the remote-stream reader polls
+    `take_stream_fault()`.  Each injector fires at most once."""
+
+    def __init__(self, fault: Fault):
+        self.fault = fault
+        self.fired = False
+
+    def install(self) -> "FaultInjector":
+        global _ACTIVE_INJECTOR
+        _ACTIVE_INJECTOR = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE_INJECTOR
+        if _ACTIVE_INJECTOR is self:
+            _ACTIVE_INJECTOR = None
+
+    def at_step(self, step: int) -> None:
+        if self.fired or step < self.fault.step:
+            return
+        kind = self.fault.kind
+        if kind == "kill-process":
+            self.fired = True
+            print(f"[chaos] SIGKILL self at step {step}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif kind == "preempt":
+            self.fired = True
+            print(f"[chaos] SIGTERM self at step {step}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif kind == "stall-data":
+            self.fired = True
+            print(f"[chaos] stalling data path {self.fault.stall_s}s at "
+                  f"step {step}", flush=True)
+            time.sleep(self.fault.stall_s)
+
+    def wants_checkpoint_fault(self) -> bool:
+        return not self.fired and self.fault.kind in (
+            "corrupt-checkpoint", "truncate-checkpoint"
+        )
+
+    def after_checkpoint(self, path: str, step: int) -> None:
+        if not self.wants_checkpoint_fault() or step < self.fault.step:
+            return
+        self.fired = True
+        if self.fault.kind == "corrupt-checkpoint":
+            print(f"[chaos] corrupting checkpoint {path}", flush=True)
+            corrupt_file(path)
+        else:
+            print(f"[chaos] truncating checkpoint {path}", flush=True)
+            truncate_file(path)
+
+
+def take_stream_fault() -> bool:
+    """True exactly once when a drop-remote-stream fault is armed — the
+    resuming HTTP reader severs its connection mid-read to exercise the
+    Range-request reconnect path."""
+    inj = _ACTIVE_INJECTOR
+    if inj is not None and not inj.fired and inj.fault.kind == "drop-remote-stream":
+        inj.fired = True
+        return True
+    return False
+
+
+def corrupt_file(path: str, offset: Optional[int] = None, nbytes: int = 64) -> None:
+    """Overwrite `nbytes` with garbage near the head of the file (the first
+    zip member — `__meta` — so structural validation catches it), in place.
+    The chaos primitive behind corrupt-checkpoint."""
+    size = os.path.getsize(path)
+    if offset is None:
+        offset = min(64, max(size - nbytes, 0))
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(b"\xde\xad\xbe\xef" * (max(nbytes, 4) // 4))
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Cut the file to `frac` of its size — models a crash mid-copy or a
+    torn download.  Kills the zip central directory, so `np.load` fails at
+    open and validation raises TruncatedCheckpointError."""
+    size = os.path.getsize(path)
+    os.truncate(path, max(int(size * frac), 0))

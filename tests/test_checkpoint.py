@@ -284,3 +284,49 @@ def test_sharded_roundtrip(tmp_path):
     assert meta["epoch"] == 1
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(16.0))
     assert restored["w"].sharding == sharding
+
+
+def test_rotation_orders_by_step_number_not_mtime(tmp_path):
+    """ISSUE 3 satellite: rotation must parse the step from the filename —
+    mtime lies under clock skew or a `cp` restore, and evicting the NEWEST
+    checkpoint would destroy the resume point."""
+    import os
+    import time
+
+    for i in (1, 2, 10, 20):  # 10 > 2 numerically, though "10" < "2" lexically
+        save_checkpoint(str(tmp_path / f"m_step{i}.npz"), {"w": {"x": jnp.zeros(1)}}, {})
+    # clock skew: the OLDEST step gets the newest mtime
+    now = time.time()
+    os.utime(tmp_path / "m_step1.npz", (now + 3600, now + 3600))
+    rotate_checkpoints(str(tmp_path), "m_step*.npz", keep_n=2)
+    left = sorted(p.name for p in tmp_path.glob("m_step*.npz"))
+    assert left == ["m_step10.npz", "m_step20.npz"]
+
+
+def test_rotation_never_touches_tmp_files(tmp_path):
+    """An in-progress `*.tmp` write (the async writer's scratch file) must
+    neither count against keep_n nor be deleted."""
+    for i in (1, 2, 3):
+        save_checkpoint(str(tmp_path / f"m_step{i}.npz"), {"w": {"x": jnp.zeros(1)}}, {})
+    (tmp_path / "m_step4.npz.tmp").write_bytes(b"partial")
+    rotate_checkpoints(str(tmp_path), "m_step*", keep_n=2)
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "m_step2.npz", "m_step3.npz", "m_step4.npz.tmp"
+    ]
+
+
+def test_save_checkpoint_fsyncs_before_rename(tmp_path, monkeypatch):
+    """ISSUE 3 satellite: the tmp file is flushed + fsynced BEFORE
+    os.replace — a crash right after rotation cannot leave zero durable
+    checkpoints."""
+    import os
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync", lambda fd: events.append("fsync") or real_fsync(fd))
+    monkeypatch.setattr(
+        os, "replace", lambda a, b: events.append("replace") or real_replace(a, b)
+    )
+    save_checkpoint(str(tmp_path / "c.npz"), {"w": {"x": jnp.zeros(1)}}, {})
+    assert "fsync" in events and "replace" in events
+    assert events.index("fsync") < events.index("replace")

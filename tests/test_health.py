@@ -413,6 +413,10 @@ def test_train_dalle_health_smoke_localizes_injected_nan(tmp_path, monkeypatch):
     train_dalle.main([
         "--dummy_run", "3", "--health_every", "1",
         "--health_inject_nan", "1:transformer",
+        # this test pins the NO-recovery observability behavior (alarm
+        # persistence); the automatic divergence rollback has its own
+        # end-to-end coverage in tests/test_resilience.py
+        "--rollback_retries", "0",
         "--telemetry", str(tele_dir),
         "--dalle_output_file_name", str(out),
         "--num_workers", "0", "--prefetch_batches", "0",

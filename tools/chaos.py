@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Fault-injection harness CLI (the operator-facing half of
+dalle_pytorch_tpu/training/resilience.py).
+
+Two ways to break a training run on purpose:
+
+* **In-process** — pass `--inject_fault KIND@STEP` to train_dalle/train_vae
+  (kinds: kill-process, preempt, corrupt-checkpoint, truncate-checkpoint,
+  stall-data, drop-remote-stream; stall-data accepts `@STEP:SECONDS`).  The
+  training loop drives the fault at exactly the named step — this is what
+  the crash-and-resume equivalence tests use.
+* **From outside** — this CLI damages artifacts or signals a live run:
+
+      python tools/chaos.py corrupt  CKPT.npz      # garbage bytes into it
+      python tools/chaos.py truncate CKPT.npz --frac 0.5
+      python tools/chaos.py validate CKPT.npz      # what would resume say?
+      python tools/chaos.py preempt  PID           # SIGTERM (graceful path)
+      python tools/chaos.py kill     PID           # SIGKILL (hard crash)
+
+The repeatable experiment: start a run with `--save_every_n_steps N`, break
+it (either way), restart with `--resume auto`, and diff the per-step loss
+sequence against an uninterrupted run — tests/test_resilience.py automates
+exactly that.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dalle_pytorch_tpu.training.resilience import (  # noqa: E402
+    FAULT_KINDS,
+    CheckpointInvalidError,
+    Fault,
+    FaultInjector,
+    corrupt_file,
+    parse_fault,
+    truncate_file,
+    validate_checkpoint,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "Fault",
+    "FaultInjector",
+    "corrupt_file",
+    "parse_fault",
+    "truncate_file",
+    "validate_checkpoint",
+]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("corrupt", help="overwrite bytes near the head of a file")
+    p.add_argument("path")
+    p.add_argument("--nbytes", type=int, default=64)
+
+    p = sub.add_parser("truncate", help="cut a file to a fraction of its size")
+    p.add_argument("path")
+    p.add_argument("--frac", type=float, default=0.5)
+
+    p = sub.add_parser("validate", help="run resume validation on a checkpoint")
+    p.add_argument("path")
+
+    p = sub.add_parser("preempt", help="SIGTERM a live run (graceful shutdown)")
+    p.add_argument("pid", type=int)
+
+    p = sub.add_parser("kill", help="SIGKILL a live run (hard crash)")
+    p.add_argument("pid", type=int)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "corrupt":
+        corrupt_file(args.path, nbytes=args.nbytes)
+        print(f"corrupted {args.path}")
+    elif args.cmd == "truncate":
+        truncate_file(args.path, frac=args.frac)
+        print(f"truncated {args.path}")
+    elif args.cmd == "validate":
+        try:
+            meta = validate_checkpoint(args.path)
+        except CheckpointInvalidError as e:
+            print(f"INVALID ({type(e).__name__}): {e}")
+            return 1
+        print(f"valid: epoch={meta.get('epoch')} "
+              f"global_step={meta.get('global_step')} "
+              f"data_state={meta.get('data_state')}")
+    elif args.cmd == "preempt":
+        os.kill(args.pid, signal.SIGTERM)
+        print(f"sent SIGTERM to {args.pid} (expect exit code 75 + emergency "
+              "checkpoint; restart with --resume auto)")
+    elif args.cmd == "kill":
+        os.kill(args.pid, signal.SIGKILL)
+        print(f"sent SIGKILL to {args.pid} (restart with --resume auto)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,10 @@ JIT_PURE = (
     "dalle_pytorch_tpu/kernels",
     "dalle_pytorch_tpu/parallel/train_step.py",
     "dalle_pytorch_tpu/observability/health.py",
+    # resilience.py's in-graph half (nonfinite_guard) traces inside the
+    # train step; its deliberate host-side file/PRNG work is waived
+    # line-by-line with host-sync-ok
+    "dalle_pytorch_tpu/training/resilience.py",
 )
 
 WAIVER = "host-sync-ok"
