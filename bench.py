@@ -248,6 +248,27 @@ def main():
         with rec.span("block"):
             float(metrics["loss"])
         tele_steps.append(rec.end_step())
+
+    # fleet skew row (ISSUE 4): the same FleetAggregator the multi-host CLIs
+    # run, fed this process's synced pass — on one process the skew is
+    # trivially 1.0, but the gather/reduce/gauge path is the real one, and
+    # the row documents the numbers a multi-host bench would report
+    from dalle_pytorch_tpu.observability.fleet import FleetAggregator
+
+    fleet_agg = FleetAggregator(process_index=0, process_count=1)
+    fleet_rec = None
+    for i, s in enumerate(tele_steps):
+        fleet_rec = fleet_agg.observe_window(
+            i, s.get("spans", {}), s.get("dur_s", 0.0), 1
+        ) or fleet_rec
+    fleet_row = None
+    if fleet_rec is not None:
+        fleet_row = {
+            "processes": fleet_rec["processes"],
+            "step_time_median_s": round(fleet_rec["step_time"]["median_s"], 5),
+            "skew_ratio": fleet_rec["skew_ratio"],
+            "slowest_process": fleet_rec["slowest_process"],
+        }
     ca = step_cost_analysis(step_fn, state, batch_data, jax.random.PRNGKey(201))
     compiled_flops = (ca or {}).get("flops")
     watcher.stop()
@@ -268,6 +289,31 @@ def main():
     params_million = round(
         sum(x.size for x in jax.tree_util.tree_leaves(state.params)) / 1e6, 1
     )
+
+    # comms ledger + roofline (ISSUE 4): the analytic wire-bytes model for
+    # this config on a representative multi-axis mesh (dp4 x tp2), priced
+    # without devices — the per-axis bytes the multi-chip run of THIS model
+    # would move per step, and whether it would be comms- or compute-bound
+    # at the chip's peak/ICI numbers
+    from dalle_pytorch_tpu.observability import comms as comms_mod
+
+    comms_mesh = {"dp": 4, "tp": 2}
+    comms_ledger = comms_mod.dalle_step_comms(
+        comms_mesh, state.params, cfg, batch, settings=settings
+    )
+    comms_row = {
+        "mesh": comms_mesh,
+        "per_axis_mb": {r["axis"]: round(r["bytes_per_step"] / 1e6, 3)
+                        for r in comms_ledger["per_axis"]},
+        "total_mb_per_step": round(comms_ledger["total_bytes_per_step"] / 1e6, 3),
+        "roofline": {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in comms_mod.comms_roofline(
+                comms_ledger["total_bytes_per_step"], flops,
+                n_chips=comms_mesh["dp"] * comms_mesh["tp"],
+            ).items()
+        },
+    }
 
     # diagnostic-step overhead (ISSUE 2): step time with the in-graph health
     # diagnostics (with_health=True — per-leaf norms, nonfinite masks, the
@@ -498,6 +544,8 @@ def main():
     common = {
         "proxy_dim2048_depth8": proxy_row,
         "telemetry": telemetry_row,
+        "fleet": fleet_row,
+        "comms": comms_row,
         "health_overhead": health_row,
         "async_checkpoint": async_checkpoint_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,

@@ -219,6 +219,43 @@ def build_parser() -> argparse.ArgumentParser:
                              "per-step time splits into data_wait / dispatch "
                              "/ block; 0: never block (dispatch-ahead "
                              "preserved, block time reads as 0)")
+    # fleet observability (observability/fleet.py + comms.py + capture.py)
+    parser.add_argument("--fleet", type=int, default=1,
+                        help="1 (default): cross-host fleet aggregation at "
+                             "the log cadence — per-phase skew gauges, "
+                             "slowest-host id, straggler alarm, and the "
+                             "analytic comms ledger (bytes/step per mesh "
+                             "axis, cross-checked vs XLA).  0 disables.  "
+                             "Collective on multi-process runs (one tiny "
+                             "all-gather per log window); the train-step "
+                             "HLO is identical either way")
+    parser.add_argument("--straggler_factor", type=float, default=1.5,
+                        help="straggler alarm threshold: a host whose step "
+                             "time exceeds this factor x the fleet median "
+                             "(and its EMA) for --straggler_patience "
+                             "consecutive log windows is alarmed")
+    parser.add_argument("--straggler_patience", type=int, default=3,
+                        help="consecutive slow log windows before the "
+                             "straggler alarm fires")
+    parser.add_argument("--profile_on_alarm", type=int, default=3, metavar="N",
+                        help="capture a jax.profiler trace of the next N "
+                             "steps whenever an alarm fires (straggler, "
+                             "recompile, divergence, health, hang) — rate-"
+                             "limited to one capture per 15 min, 2 per run; "
+                             "traces land under <telemetry>/traces.  0 "
+                             "disables.  SIGUSR2 requests the same capture "
+                             "manually on a live run")
+    parser.add_argument("--profile_steps", type=str, default=None,
+                        metavar="A:B",
+                        help="manually capture a profiler trace of steps "
+                             "[A, B) into <telemetry>/traces (bypasses the "
+                             "on-alarm rate limit)")
+    parser.add_argument("--fleet_inject_skew", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="test hook: sleep this long inside every step "
+                             "on THIS process — makes it a deliberate "
+                             "straggler so the alarm + capture path can be "
+                             "exercised end to end")
     # training-health diagnostics (observability/health.py)
     parser.add_argument("--health_every", type=int, default=0, metavar="N",
                         help="run the in-graph health diagnostic step every N "
@@ -301,7 +338,7 @@ def reconstitute_vae(args, resume=None):
 
 def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
                         global_step=0, wandb_run_id=None, health_state=None,
-                        data_state=None):
+                        data_state=None, fleet_state=None):
     """(trees, meta) for a checkpoint — the device->host gather happens HERE
     (np.asarray inside to_host), so the result is a consistent snapshot that
     can be serialized later on the async writer thread.  `data_state`
@@ -324,13 +361,14 @@ def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
         "scheduler_state": None,
         "health_state": health_state,
         "data_state": data_state,
+        "fleet_state": fleet_state,
     }
     return trees, meta
 
 
 def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
                global_step=0, wandb_run_id=None, health_state=None,
-               data_state=None, writer=None):
+               data_state=None, fleet_state=None, writer=None):
     """Gather + write one npz checkpoint.  With `writer` (an
     AsyncCheckpointWriter), only the gather runs here — serialization,
     fsync, atomic rename, and rotation happen on the writer thread and this
@@ -338,7 +376,7 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
     trees, meta = build_model_payload(
         state, dalle_cfg, vae_params, vae_cfg, epoch, global_step=global_step,
         wandb_run_id=wandb_run_id, health_state=health_state,
-        data_state=data_state,
+        data_state=data_state, fleet_state=fleet_state,
     )
     glob_pat = _rotation_glob(path) if keep_n is not None else None
     if writer is not None:
@@ -363,7 +401,7 @@ def _rotation_glob(path) -> str:
 
 def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                        keep_n=None, global_step=0, wandb_run_id=None,
-                       health_state=None, data_state=None):
+                       health_state=None, data_state=None, fleet_state=None):
     """Distributed save: the TrainState goes through orbax, each host writing
     only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
     never gathered (`save_model`'s np.asarray would pull the full arrays to
@@ -382,6 +420,7 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
         "scheduler_state": None,
         "health_state": health_state,
         "data_state": data_state,
+        "fleet_state": fleet_state,
     }
     path = Path(path)
     save_sharded(
@@ -795,6 +834,8 @@ def main(argv=None):
     # telemetry: on by default (JSONL-only — no profiler infrastructure
     # needed); --telemetry DIR redirects it, --telemetry off disables
     tele = None
+    fleet_agg = None
+    capture = None
     if args.telemetry != "off":
         tele_dir = args.telemetry or f"{args.dalle_output_file_name}.telemetry"
         tele = telemetry.configure(
@@ -805,6 +846,39 @@ def main(argv=None):
         if is_root:
             print(f"[telemetry] spans + metrics + hang dumps -> {tele_dir} "
                   f"(render with tools/telemetry_report.py)")
+        # fleet observability: cross-host skew gauges + straggler alarm at
+        # the log cadence (observability/fleet.py); merged offline with
+        # tools/fleet_report.py
+        if args.fleet:
+            from dalle_pytorch_tpu.observability.fleet import FleetAggregator
+
+            fleet_agg = tele.attach_fleet(FleetAggregator(
+                process_index=be.get_rank(), process_count=be.get_world_size(),
+                skew_factor=args.straggler_factor,
+                patience=args.straggler_patience,
+            ))
+            # straggler EMA/streaks survive restarts through checkpoint meta
+            # (same discipline as the DivergenceMonitor state)
+            fleet_agg.load_state_dict((resume_meta or {}).get("fleet_state"))
+            if is_root and be.get_world_size() > 1:
+                print(f"[fleet] skew gauges + straggler alarm over "
+                      f"{be.get_world_size()} processes (render with "
+                      "tools/fleet_report.py)")
+        # on-alarm / manual / SIGUSR2 profiler capture (observability/capture)
+        from dalle_pytorch_tpu.observability import capture as capture_mod
+
+        manual_window = (capture_mod.parse_profile_steps(args.profile_steps)
+                         if args.profile_steps else None)
+        if args.profile_on_alarm or manual_window is not None:
+            capture = capture_mod.TraceTrigger(
+                dir=str(Path(tele_dir) / "traces"),
+                window_steps=args.profile_on_alarm or 1,
+                manual_window=manual_window,
+                recorder=tele.spans,
+                process_index=be.get_rank(),
+            ).install_sigusr2()
+            if args.profile_on_alarm:
+                tele.add_alarm_listener(capture.on_alarm)
 
     # training-health diagnostics: per-layer numerics + divergence alarms on
     # a second jitted step every --health_every steps (observability/health)
@@ -876,6 +950,7 @@ def main(argv=None):
         t0 = time.perf_counter()
         health_state = (health_monitor.state_dict()
                         if health_monitor is not None else None)
+        fleet_state = (fleet_agg.state_dict() if fleet_agg is not None else None)
         with telemetry.span("checkpoint", path=str(path)):
             if args.sharded_checkpoint:
                 save_model_sharded(
@@ -883,14 +958,14 @@ def main(argv=None):
                     keep_n=keep_n,
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
-                    data_state=ds)
+                    data_state=ds, fleet_state=fleet_state)
             else:
                 save_model(
                     path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                     keep_n=keep_n,
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
-                    data_state=ds, writer=writer)
+                    data_state=ds, fleet_state=fleet_state, writer=writer)
         obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
         if writer is None:
             # the async writer counts completions itself (checkpoints_saved)
@@ -929,7 +1004,9 @@ def main(argv=None):
 
     def finish_telemetry():
         if tele is not None:
-            tele.flush(logger, step=global_step)
+            # fleet=False: exit paths are not step-synchronized across
+            # processes — a lone flusher must not block in the fleet gather
+            tele.flush(logger, step=global_step, fleet=False)
             tele.close()
         logger.finish()
 
@@ -998,6 +1075,10 @@ def main(argv=None):
                         injector.at_step(global_step)
                     if tele is not None:
                         tele.begin_step(global_step)
+                    if capture is not None:
+                        # starts a pending/manual/SIGUSR2 profiler window —
+                        # on the training thread, before this step dispatches
+                        capture.on_step_start(global_step)
                     with telemetry.span("data_wait"):
                         device_batch = next(batch_it, None)
                     if device_batch is None:
@@ -1024,6 +1105,7 @@ def main(argv=None):
                         flops_checked = True
                         checked_recompiles = recompiles_now
                         with telemetry.span("flops_crosscheck"):
+                            from dalle_pytorch_tpu.observability import comms as comms_mod
                             from dalle_pytorch_tpu.training.profiling import (
                                 dalle_step_flops, matmul_param_count,
                             )
@@ -1032,8 +1114,30 @@ def main(argv=None):
                                 dalle_cfg, int(device_batch["text"].shape[0]),
                                 matmul_param_count(state.params),
                             )
+                            # comms ledger: analytic bytes/step per mesh axis
+                            # from the mesh + sharding settings, published as
+                            # gauges + a JSONL event, cross-checked against
+                            # cost_analysis bytes-accessed, and priced on the
+                            # comms-vs-compute roofline
+                            ledger = comms_mod.dalle_step_comms(
+                                getattr(step_fn, "mesh", None), state.params,
+                                dalle_cfg, int(device_batch["text"].shape[0]),
+                                settings=settings,
+                            )
+                            ledger_bytes = None
+                            if ledger is not None and args.fleet:
+                                import math as _math
+
+                                comms_mod.publish_gauges(ledger, obs_metrics.REGISTRY)
+                                ledger["roofline"] = comms_mod.comms_roofline(
+                                    ledger["total_bytes_per_step"], analytic,
+                                    n_chips=_math.prod(ledger["mesh"].values()),
+                                )
+                                tele.spans.write_event("comms_ledger", **ledger)
+                                ledger_bytes = ledger["total_bytes_per_step"]
                             ratio = tele.crosscheck_flops(
-                                step_fn, (state, device_batch, sk), analytic
+                                step_fn, (state, device_batch, sk), analytic,
+                                analytic_comms_bytes=ledger_bytes,
                             )
                             if tele.compile_watcher is not None:
                                 # re-snapshot: anything the crosscheck itself fired
@@ -1042,6 +1146,13 @@ def main(argv=None):
                             if is_root and ratio is not None:
                                 print(f"[telemetry] compiled/analytic FLOPs ratio: "
                                       f"{ratio:.3f}")
+                            if is_root and ledger_bytes:
+                                print("[fleet] comms ledger: "
+                                      + ", ".join(
+                                          f"{r['axis']}={r['bytes_per_step'] / 1e6:.2f}MB"
+                                          for r in ledger["per_axis"])
+                                      + f" per step ({ledger['roofline']['bound']}-bound "
+                                        "at peak)")
                     health_step = bool(args.health_every) and (
                         global_step % args.health_every == 0
                     )
@@ -1159,6 +1270,12 @@ def main(argv=None):
                             if tele is not None:
                                 tele.close()
                             return state, dalle_cfg
+                    if args.fleet_inject_skew > 0:
+                        # test hook: make THIS process a straggler (inside
+                        # the step window so the skew shows up in dur_s)
+                        time.sleep(args.fleet_inject_skew)
+                    if capture is not None:
+                        capture.on_step_end(global_step)
                     if tele is not None:
                         tele.finish_step(global_step)
                     if shutdown.requested:
@@ -1241,6 +1358,8 @@ def main(argv=None):
             skip_pending.clear()
             if health_monitor is not None:
                 health_monitor.load_state_dict(meta_rb.get("health_state"))
+            if fleet_agg is not None:
+                fleet_agg.load_state_dict(meta_rb.get("fleet_state"))
             if is_root:
                 print(f"[resilience] rolled back to {found} (attempt "
                       f"{rollback_attempts}/{args.rollback_retries}) after "
@@ -1255,12 +1374,16 @@ def main(argv=None):
                 logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
     finally:
         shutdown.uninstall()
+        if capture is not None:
+            capture.close()  # stop an in-flight trace + restore SIGUSR2
         if injector is not None:
             injector.uninstall()  # the global must not leak across main()s
         if writer is not None:
             writer.close()
     if tele is not None:
-        tele.flush(logger, step=global_step)
+        # fleet=False: the epoch loop's tail is not step-synchronized
+        # (save/sample cadences differ per process role)
+        tele.flush(logger, step=global_step, fleet=False)
         if is_root:
             print(f"[telemetry] run summary: {tele.summary()}")
         tele.close()

@@ -69,6 +69,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="1 (default): block on each step's result so "
                              "per-step time splits into data_wait / dispatch "
                              "/ block; 0: never block")
+    parser.add_argument("--fleet", type=int, default=1,
+                        help="1 (default): cross-host fleet aggregation at "
+                             "the log cadence (skew gauges, slowest-host id, "
+                             "straggler alarm); 0 disables")
+    parser.add_argument("--profile_on_alarm", type=int, default=3, metavar="N",
+                        help="capture a jax.profiler trace of the next N "
+                             "steps whenever an alarm fires (rate-limited); "
+                             "0 disables.  SIGUSR2 requests one manually")
+    parser.add_argument("--profile_steps", type=str, default=None,
+                        metavar="A:B",
+                        help="manually capture a profiler trace of steps "
+                             "[A, B) into <telemetry>/traces")
+    parser.add_argument("--fleet_inject_skew", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="test hook: sleep this long inside every step "
+                             "on THIS process (deliberate straggler)")
     parser.add_argument("--health_every", type=int, default=0, metavar="N",
                         help="run the in-graph health diagnostic step every N "
                              "steps (0 disables): per-layer grad/param/update "
@@ -94,13 +110,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None,
-               writer=None):
+               fleet_state=None, writer=None):
     """Gather + write the VAE checkpoint.  With `writer` (an
     AsyncCheckpointWriter) only the host gather runs here; serialization +
     fsync + rename happen on the writer thread."""
     trees = {"weights": to_host(params)}
     meta = {"hparams": cfg.to_dict(), "version": __version__,
-            "health_state": health_state}
+            "health_state": health_state, "fleet_state": fleet_state}
     if writer is not None:
         writer.submit(path, trees, meta)
         return
@@ -135,6 +151,7 @@ def main(argv=None):
     # with validate_checkpoint's distinct error.  Optimizer state starts
     # fresh — the VAE checkpoint stores weights only.
     resume_params = None
+    resume_meta = None
     if args.resume is not None:
         rpath = (f"{args.vae_output_file_name}.pt" if args.resume == "auto"
                  else args.resume)
@@ -151,6 +168,7 @@ def main(argv=None):
 
             trees, meta = load_checkpoint(rpath)
             cfg = DiscreteVAEConfig(**meta["hparams"])
+            resume_meta = meta
             resume_params = jax.tree_util.tree_map(jnp.asarray, trees["weights"])
             if is_root:
                 print(f"[resilience] resumed VAE weights from {rpath} "
@@ -175,15 +193,39 @@ def main(argv=None):
     )
 
     tele = None
+    capture = None
+    fleet_agg = None
     if args.telemetry != "off":
         from pathlib import Path as _Path
 
+        tele_dir = args.telemetry or f"{args.vae_output_file_name}.telemetry"
         tele = telemetry.configure(
-            dir=args.telemetry or f"{args.vae_output_file_name}.telemetry",
+            dir=tele_dir,
             run_name=_Path(args.vae_output_file_name).name,
             heartbeat_s=args.telemetry_heartbeat_s or None,
             process_index=be.get_rank(),
         )
+        if args.fleet:
+            from dalle_pytorch_tpu.observability.fleet import FleetAggregator
+
+            fleet_agg = tele.attach_fleet(FleetAggregator(
+                process_index=be.get_rank(), process_count=be.get_world_size(),
+            ))
+            fleet_agg.load_state_dict((resume_meta or {}).get("fleet_state"))
+        from dalle_pytorch_tpu.observability import capture as capture_mod
+
+        manual_window = (capture_mod.parse_profile_steps(args.profile_steps)
+                         if args.profile_steps else None)
+        if args.profile_on_alarm or manual_window is not None:
+            capture = capture_mod.TraceTrigger(
+                dir=str(_Path(tele_dir) / "traces"),
+                window_steps=args.profile_on_alarm or 1,
+                manual_window=manual_window,
+                recorder=tele.spans,
+                process_index=be.get_rank(),
+            ).install_sigusr2()
+            if args.profile_on_alarm:
+                tele.add_alarm_listener(capture.on_alarm)
 
     @functools.partial(jax.jit, static_argnames=("with_health",))
     def train_step(params, opt_state, images, key, temp, lr, with_health=False):
@@ -239,6 +281,9 @@ def main(argv=None):
     def _health_state():
         return health_monitor.state_dict() if health_monitor is not None else None
 
+    def _fleet_state():
+        return fleet_agg.state_dict() if fleet_agg is not None else None
+
     out_file = f"{args.vae_output_file_name}.pt"
     # async checkpoint writer + preemption-safe shutdown (training/resilience)
     writer = resilience.AsyncCheckpointWriter() if args.async_checkpoint else None
@@ -261,14 +306,16 @@ def main(argv=None):
         obs_metrics.counter("shutdown_requests").inc()
         if is_root:
             save_model(out_file, params, cfg, health_state=_health_state(),
-                       writer=writer)
+                       fleet_state=_fleet_state(), writer=writer)
         if writer is not None:
             writer.flush()
         if is_root:
             print(f"[resilience] preemption checkpoint written; exiting with "
                   f"code {resilience.EXIT_PREEMPTED}", flush=True)
         if tele is not None:
-            tele.flush(logger, step=global_step)
+            # fleet=False: a preempting process is not step-synchronized
+            # with its peers — it must not block in the fleet gather
+            tele.flush(logger, step=global_step, fleet=False)
             tele.close()
         logger.finish()
         # the SystemExit unwinds through the training loop's try/finally,
@@ -296,6 +343,8 @@ def main(argv=None):
                     injector.at_step(global_step)
                 if tele is not None:
                     tele.begin_step(global_step)
+                if capture is not None:
+                    capture.on_step_start(global_step)
                 with telemetry.span("data_wait"):
                     images = next(batch_it, None)
                 if images is None:
@@ -370,7 +419,8 @@ def main(argv=None):
                         # async writer: the span covers only the host gather
                         # + enqueue; serialize/fsync run on the writer thread
                         save_model(out_file, params, cfg,
-                                   health_state=_health_state(), writer=writer)
+                                   health_state=_health_state(),
+                                   fleet_state=_fleet_state(), writer=writer)
                     obs_metrics.histogram("checkpoint_save_s").observe(
                         time.perf_counter() - t_save
                     )
@@ -378,6 +428,10 @@ def main(argv=None):
                         if writer is not None:
                             writer.flush()
                         injector.after_checkpoint(out_file, global_step)
+                if args.fleet_inject_skew > 0:
+                    time.sleep(args.fleet_inject_skew)  # deliberate straggler
+                if capture is not None:
+                    capture.on_step_end(global_step)
                 if tele is not None:
                     tele.finish_step(global_step)
                 if shutdown.requested:
@@ -389,18 +443,21 @@ def main(argv=None):
             lr *= args.lr_decay_rate
             if is_root:
                 save_model(out_file, params, cfg,
-                           health_state=_health_state(), writer=writer)
+                           health_state=_health_state(),
+                           fleet_state=_fleet_state(), writer=writer)
                 logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
     finally:
         # an exception mid-training must still drain queued async saves
         # (and surface their write errors) and restore the signal handlers
         shutdown.uninstall()
+        if capture is not None:
+            capture.close()  # stop an in-flight trace + restore SIGUSR2
         if injector is not None:
             injector.uninstall()  # the global must not leak across main()s
         if writer is not None:
             writer.close()
     if tele is not None:
-        tele.flush(logger, step=global_step)
+        tele.flush(logger, step=global_step, fleet=False)  # tail: not synced
         tele.close()
     logger.finish()
     return params, cfg

@@ -9,6 +9,14 @@ Instrumented code imports the cheap module-level helpers:
 which are no-ops / registry updates until a CLI calls
 `telemetry.configure(dir=...)`.  See tools/telemetry_report.py for turning a
 run's spans JSONL into a per-step time-attribution table."""
+from dalle_pytorch_tpu.observability.capture import TraceTrigger, parse_profile_steps
+from dalle_pytorch_tpu.observability.comms import (
+    CommsCrosscheck,
+    comms_roofline,
+    dalle_step_comms,
+    step_comms_ledger,
+)
+from dalle_pytorch_tpu.observability.fleet import FleetAggregator, merge_step_records
 from dalle_pytorch_tpu.observability.health import (
     capture_taps,
     leaf_paths,
@@ -43,23 +51,31 @@ from dalle_pytorch_tpu.observability.xla import (
 
 __all__ = [
     "REGISTRY",
+    "CommsCrosscheck",
     "CompileWatcher",
     "DivergenceMonitor",
+    "FleetAggregator",
     "FlopsCrosscheck",
     "Heartbeat",
     "MetricsRegistry",
     "SpanRecorder",
     "Telemetry",
+    "TraceTrigger",
     "active",
     "capture_taps",
+    "comms_roofline",
     "configure",
     "counter",
+    "dalle_step_comms",
     "device_memory_stats",
     "gauge",
     "histogram",
     "leaf_paths",
+    "merge_step_records",
+    "parse_profile_steps",
     "record_memory_gauges",
     "span",
+    "step_comms_ledger",
     "step_cost_analysis",
     "tap",
     "tap_attention",

@@ -1,0 +1,339 @@
+"""Analytic inter-chip communication accounting.
+
+FlashAttention's lesson is that the ledger of DATA MOVEMENT — not FLOPs —
+is what explains (and fixes) a memory-bound kernel; this module keeps the
+same ledger for inter-chip movement.  Training never calls a collective
+explicitly (XLA emits them from sharding annotations, plus the pipeline's
+manual ppermute), so the bytes a mesh moves per step are *derivable* from
+the mesh shape + the sharding/settings that produced those annotations:
+
+  dp    one ring all-reduce of the gradient buffer per step
+  fsdp  ZeRO-1/2: grad all-reduce + updated-shard all-gather;
+        ZeRO-3: param all-gather per use (fwd + bwd, per microbatch)
+        + one gradient reduce-scatter
+  tp    one activation all-reduce per residual branch per direction
+        (the Megatron pattern: 2 branches x fwd+bwd per layer)
+  sp    ring attention K/V rotation (fwd) + the (q, do, lse, delta, dq)
+        backward packet — priced by parallel/ring.ring_comm_bytes, the
+        same source of truth as the schedule itself
+  pp    one stage-hop ppermute per tick, forward and explicit backward —
+        parallel/pipeline.pipeline_comm_bytes
+
+All figures are per-chip WIRE bytes per optimizer step (the ring all-reduce
+costs 2·(n-1)/n of the payload on the wire, an all-gather/reduce-scatter
+(n-1)/n).  The ledger is cross-checked against XLA's own `cost_analysis`
+bytes-accessed: the two measure different things (bytes-accessed is HBM
+traffic, dominated by local reads/writes), so — exactly like
+`FlopsCrosscheck` — the alarm fires on persistent DRIFT of the ratio from
+its first observed value, which catches a silently changed collective
+footprint (a lost sharding annotation, an accidental full-replication)
+without pretending the two numbers should ever be equal.
+
+Everything here is host-side arithmetic on static shapes — no device values
+are touched, so the module is lint-clean under tools/lint_host_sync.py by
+construction.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Union
+
+from dalle_pytorch_tpu.observability import metrics as metrics_mod
+from dalle_pytorch_tpu.observability.xla import FlopsCrosscheck
+
+# approximate aggregate per-chip ICI bandwidth (bytes/s, bidirectional sum
+# over links) — roofline pricing only, not a guarantee
+ICI_BYTES_PER_S = {
+    "v4": 300e9,
+    "v5e": 200e9,
+    "v5litepod": 200e9,
+    "v5p": 600e9,
+    "v6e": 450e9,
+}
+_DEFAULT_ICI = 200e9
+
+
+# ---------------------------------------------------------------------------
+# collective wire-cost primitives (per-chip bytes, ring algorithms)
+# ---------------------------------------------------------------------------
+
+def ring_all_reduce_bytes(payload: float, n: int) -> float:
+    """Per-chip wire bytes to all-reduce a `payload`-byte tensor over n
+    chips: reduce-scatter + all-gather, each (n-1)/n of the payload."""
+    return 2.0 * payload * (n - 1) / n if n > 1 else 0.0
+
+
+def all_gather_bytes(payload: float, n: int) -> float:
+    """Per-chip wire bytes to all-gather a tensor whose GLOBAL size is
+    `payload` bytes from n shards."""
+    return payload * (n - 1) / n if n > 1 else 0.0
+
+
+def reduce_scatter_bytes(payload: float, n: int) -> float:
+    return payload * (n - 1) / n if n > 1 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# tree sizing
+# ---------------------------------------------------------------------------
+
+def tree_float_bytes(tree: Any, itemsize: Optional[int] = None) -> float:
+    """Total bytes of the floating leaves of `tree` — in their storage dtype,
+    or repriced at `itemsize` (e.g. a grad_dtype override).  Pure shape/dtype
+    arithmetic; never reads device values."""
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = jnp.result_type(leaf)
+        if not jnp.issubdtype(dt, jnp.floating):
+            continue
+        size = getattr(leaf, "size", None)
+        if size is None:
+            continue
+        total += size * (itemsize if itemsize is not None else jnp.dtype(dt).itemsize)
+    return total
+
+
+def _itemsize(dtype) -> int:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).itemsize
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+def step_comms_ledger(
+    axes: Mapping[str, int],
+    *,
+    param_bytes: float,
+    grad_bytes: float,
+    batch: int,
+    seq_len: int,
+    dim: int,
+    depth: int,
+    heads: int,
+    dim_head: int,
+    compute_itemsize: int = 4,
+    zero_stage: int = 0,
+    grad_accum: int = 1,
+    pp_num_micro: Optional[int] = None,
+    pp_interleave: int = 1,
+) -> Dict[str, Any]:
+    """Per-chip wire bytes per optimizer step for each active mesh axis.
+
+    `axes` is {axis: size} (see parallel/mesh.axis_sizes — a plain dict works
+    too, so hypothetical meshes can be priced without devices).  `batch` is
+    the GLOBAL per-step batch; activations are sharded over (dp, fsdp), so
+    activation collectives are priced at the local batch."""
+    d = int(axes.get("dp", 1))
+    f = int(axes.get("fsdp", 1))
+    t = int(axes.get("tp", 1))
+    s = int(axes.get("sp", 1))
+    p = int(axes.get("pp", 1))
+
+    data_shards = max(d * f, 1)
+    batch_local = max(batch // data_shards, 1)
+    # params (and so gradients) are sharded over tp at rest (Megatron
+    # column/row specs) and over pp (sharding.py folds pp into the
+    # data-sharding axes), so the dp/fsdp collectives each chip runs move
+    # only its OWN shard of the tree.  Approximation: every leaf is treated
+    # as tp/pp-shardable — matmul weights (the tree's mass) are; the small
+    # non-TP-ruled leaves (norms, biases without a rule) are over-divided.
+    param_shard = 1.0 / max(t * p, 1)
+    grad_local = grad_bytes * param_shard
+    param_local = param_bytes * param_shard
+    per_axis: List[Dict[str, Any]] = []
+
+    if d > 1:
+        per_axis.append({
+            "axis": "dp", "size": d, "op": "all_reduce",
+            "bytes_per_step": ring_all_reduce_bytes(grad_local, d),
+            "payload_bytes": grad_local,
+        })
+
+    if f > 1:
+        if zero_stage >= 3:
+            # params gathered around each use — forward and backward of every
+            # microbatch — plus one gradient reduce-scatter per step
+            gathers = 2.0 * max(grad_accum, 1)
+            per_axis.append({
+                "axis": "fsdp", "size": f,
+                "op": "all_gather+reduce_scatter", "zero_stage": zero_stage,
+                "bytes_per_step": (gathers * all_gather_bytes(param_local, f)
+                                   + reduce_scatter_bytes(grad_local, f)),
+                "payload_bytes": param_local,
+            })
+        elif zero_stage >= 1:
+            # params replicated (plain grad all-reduce), moments sharded:
+            # each chip updates its shard and all-gathers the result
+            per_axis.append({
+                "axis": "fsdp", "size": f,
+                "op": "all_reduce+all_gather", "zero_stage": zero_stage,
+                "bytes_per_step": (ring_all_reduce_bytes(grad_local, f)
+                                   + all_gather_bytes(param_local, f)),
+                "payload_bytes": grad_local,
+            })
+        else:
+            per_axis.append({
+                "axis": "fsdp", "size": f, "op": "all_reduce",
+                "zero_stage": zero_stage,
+                "bytes_per_step": ring_all_reduce_bytes(grad_local, f),
+                "payload_bytes": grad_local,
+            })
+
+    if t > 1:
+        # Megatron pattern: one activation all-reduce per residual branch
+        # (attention out-proj + ff down-proj) per direction
+        act = 1.0 * batch_local * seq_len * dim * compute_itemsize
+        per_axis.append({
+            "axis": "tp", "size": t, "op": "all_reduce",
+            "bytes_per_step": depth * 2 * 2 * ring_all_reduce_bytes(act, t),
+            "payload_bytes": act,
+            "collectives": depth * 4,
+        })
+
+    if s > 1:
+        from dalle_pytorch_tpu.parallel.ring import ring_comm_bytes
+
+        per_layer = ring_comm_bytes(
+            batch_local, heads, max(seq_len // s, 1), dim_head, s,
+            itemsize=compute_itemsize,
+        )
+        per_axis.append({
+            "axis": "sp", "size": s, "op": "ppermute_ring",
+            "bytes_per_step": depth * per_layer,
+            "payload_bytes": per_layer,
+        })
+
+    if p > 1:
+        from dalle_pytorch_tpu.parallel.pipeline import (
+            default_num_micro,
+            pipeline_comm_bytes,
+        )
+
+        num_micro = pp_num_micro or default_num_micro(batch_local, p)
+        per_axis.append({
+            "axis": "pp", "size": p, "op": "ppermute",
+            "bytes_per_step": pipeline_comm_bytes(
+                batch_local, seq_len, dim, p, num_micro=num_micro,
+                itemsize=compute_itemsize, interleave=max(pp_interleave, 1),
+            ),
+            "num_micro": num_micro,
+        })
+
+    total = sum(row["bytes_per_step"] for row in per_axis)
+    return {
+        "mesh": dict(axes),
+        "batch": batch,
+        "batch_local": batch_local,
+        "per_axis": per_axis,
+        "total_bytes_per_step": total + 0.0,
+    }
+
+
+def dalle_step_comms(mesh: Union[Mapping[str, int], Any, None], params: Any,
+                     cfg: Any, batch: int,
+                     settings: Any = None) -> Optional[Dict[str, Any]]:
+    """The ledger for a live DALLE training step: sizes from the mesh (a
+    `jax.sharding.Mesh` or a plain {axis: size} mapping), payload bytes from
+    the param tree, dtypes and ZeRO stage from the StepSettings, geometry
+    from the DALLEConfig.  Returns None without a mesh (single-chip: no
+    inter-chip traffic to account)."""
+    if mesh is None:
+        return None
+    from dalle_pytorch_tpu.parallel.mesh import axis_sizes
+
+    axes = axis_sizes(mesh)
+    param_bytes = tree_float_bytes(params)
+    if settings is not None and getattr(settings, "grad_dtype", None) is not None:
+        grad_bytes = tree_float_bytes(params, itemsize=_itemsize(settings.grad_dtype))
+    else:
+        grad_bytes = tree_float_bytes(params, itemsize=4)
+    compute_itemsize = 4
+    if settings is not None and getattr(settings, "compute_dtype", None) is not None:
+        compute_itemsize = _itemsize(settings.compute_dtype)
+    return step_comms_ledger(
+        axes,
+        param_bytes=param_bytes,
+        grad_bytes=grad_bytes,
+        batch=batch,
+        seq_len=cfg.total_seq_len,
+        dim=cfg.dim,
+        depth=cfg.depth,
+        heads=cfg.heads,
+        dim_head=cfg.dim_head,
+        compute_itemsize=compute_itemsize,
+        zero_stage=int(getattr(settings, "zero_stage", 0) or 0) if settings is not None else 0,
+        grad_accum=int(getattr(settings, "grad_accum", 1) or 1) if settings is not None else 1,
+        pp_num_micro=getattr(cfg, "pp_num_micro", None),
+        pp_interleave=int(getattr(cfg, "pp_interleave", 1) or 1),
+    )
+
+
+def publish_gauges(ledger: Mapping[str, Any], registry=None) -> None:
+    """Mirror the ledger into the metrics registry: one gauge per axis plus
+    the total — the numbers the fleet report and bench rows read back."""
+    reg = registry if registry is not None else metrics_mod.REGISTRY
+    for row in ledger.get("per_axis", []):
+        reg.gauge(f"comms/{row['axis']}_bytes_per_step").set(row["bytes_per_step"])
+    reg.gauge("comms/total_bytes_per_step").set(ledger["total_bytes_per_step"])
+
+
+def comms_roofline(total_bytes: float, step_flops: float,
+                   peak_flops: Optional[float] = None,
+                   ici_bytes_per_s: Optional[float] = None,
+                   n_chips: int = 1) -> Dict[str, Any]:
+    """Comms-vs-compute roofline for one step: time each side would take at
+    its peak, and which one bounds the step.  Overlap is the best case —
+    `bound` says which resource the step CANNOT go faster than.
+
+    BOTH sides are per-chip: `total_bytes` is the ledger's per-chip wire
+    bytes, so `step_flops` (the analytic WHOLE-step model, all chips) is
+    divided by `n_chips` — comparing fleet FLOPs against one chip's traffic
+    would bias every verdict toward compute-bound."""
+    if peak_flops is None:
+        from dalle_pytorch_tpu.training.profiling import chip_peak_flops
+
+        peak_flops = chip_peak_flops()
+    if ici_bytes_per_s is None:
+        ici_bytes_per_s = _chip_ici_bytes_per_s()
+    flops_per_chip = step_flops / max(n_chips, 1)
+    compute_s = flops_per_chip / peak_flops if peak_flops else 0.0
+    comms_s = total_bytes / ici_bytes_per_s if ici_bytes_per_s else 0.0
+    return {
+        "comms_s_at_peak": comms_s + 0.0,
+        "compute_s_at_peak": compute_s + 0.0,
+        "comms_over_compute": (comms_s / compute_s) if compute_s > 0 else None,
+        "bound": "comms" if comms_s > compute_s else "compute",
+        "n_chips": max(n_chips, 1),
+        "ici_bytes_per_s": ici_bytes_per_s + 0.0,
+        "peak_flops": peak_flops + 0.0,
+    }
+
+
+def _chip_ici_bytes_per_s(default: float = _DEFAULT_ICI) -> float:
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    except Exception:
+        return default
+    for key, val in ICI_BYTES_PER_S.items():
+        if key in kind:
+            return val
+    return default
+
+
+class CommsCrosscheck(FlopsCrosscheck):
+    """Analytic-comms vs cost_analysis bytes-accessed, with the same
+    drift-from-first-ratio persistence alarm as the FLOPs cross-check.  The
+    measured side is HBM traffic, not wire traffic — the RATIO is the
+    invariant: when it moves, either the collective footprint changed (a
+    dropped sharding annotation replicates a tensor XLA used to shard) or
+    the analytic model no longer matches the program."""
+
+    RATIO_GAUGE = "xla_bytes_over_analytic_comms"
+    ALARM_COUNTER = "comms_divergence_alarms"

@@ -1,0 +1,262 @@
+"""Cross-host fleet telemetry: skew gauges and the straggler alarm.
+
+The span/metric streams (PR 1) are strictly process-local — every process
+writes its own `run.pN.spans.jsonl` — so a multi-host run whose step time
+degrades because ONE host is slow (thermal throttle, a sick NIC, a noisy
+neighbor on its VM) looks identical to a run that is uniformly slow.  The
+FleetAggregator closes that gap without touching the train step: at the log
+cadence, every process contributes its window-mean step-phase times
+(data_wait / dispatch / block / checkpoint + the step total) to ONE small
+all-gather — `multihost_utils.process_allgather`, outside jit, a few dozen
+floats — and every process then knows the whole fleet's timing:
+
+* skew gauges: per-phase max / min / median across hosts, the max/median
+  step-time ratio, and the slowest host's process index — the "which host,
+  which phase" answer a mystery step-time regression needs;
+* the straggler alarm: a host whose window-mean step time stays above
+  `skew_factor x` both the fleet median AND the EMA of that median for
+  `patience` consecutive windows.  The double condition matters: a
+  uniformly slow fleet raises the median with it (no alarm — that is a
+  different bug), and the EMA guard keeps one noisy window from arming it.
+
+The gather is collective: every process must call `observe_window` at the
+same cadence (the CLIs key it off the step-count log cadence, which is
+deterministic across processes).  This leans on the same invariant
+global-mesh training itself already requires — every process must run the
+SAME number of steps (each jitted step is a cross-process collective, so
+per-process data-count divergence wedges the run in the step long before
+it reaches a fleet gather); the CLI exit paths that are NOT
+step-synchronized (preemption, rollback-abort, end-of-run tails) flush
+with fleet=False.  Single-process runs skip the collective and still
+publish the gauges (skew trivially 1.0), so the code path is always live.
+
+This module deliberately host-syncs at the LOG cadence (that is its job);
+the per-step path never blocks.  tools/lint_host_sync.py covers it so any
+new sync added outside the waived gather stays visible in review.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.observability import metrics as metrics_mod
+
+# the step phases every process reports, in gather-vector order; "total" is
+# the whole-step wall clock (spans outside these phases land in its residue)
+PHASES = ("data_wait", "dispatch", "block", "checkpoint")
+_EPS = 1e-9
+
+
+def _default_gather(vec: np.ndarray) -> np.ndarray:
+    """All-gather one float32 vector across processes -> (n_processes, k),
+    row-ordered by process index.  Outside jit; compiles one tiny allgather
+    executable on first use (the Telemetry wiring shields it from the
+    recompile watcher)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return vec[None, :]
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.process_allgather(jnp.asarray(vec, jnp.float32))
+    return np.asarray(out)  # host-sync-ok: the log-cadence fleet gather
+
+
+class FleetAggregator:
+    """Gathers per-process step-phase timings and publishes fleet-level skew
+    gauges + the straggler alarm.  `gather_fn` is injectable for tests (and
+    for the bench's single-process row); the default is the
+    multihost_utils all-gather."""
+
+    def __init__(self, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 gather_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 skew_factor: float = 1.5, patience: int = 3,
+                 ema_decay: float = 0.8,
+                 on_alarm: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 registry=None):
+        if process_index is None or process_count is None:
+            import jax
+
+            process_index = jax.process_index() if process_index is None else process_index
+            process_count = jax.process_count() if process_count is None else process_count
+        self.process_index = process_index
+        self.process_count = process_count
+        self.gather_fn = gather_fn or _default_gather
+        self.skew_factor = skew_factor
+        self.patience = patience
+        self.ema_decay = ema_decay
+        self.on_alarm = on_alarm
+        self.registry = registry if registry is not None else metrics_mod.REGISTRY
+        self._median_ema: Optional[float] = None
+        self._streaks: Dict[int, int] = {}
+        self._alarmed: Dict[int, bool] = {}
+        self.windows = 0
+        self.alarms = 0
+
+    # -- one log-cadence window ---------------------------------------------
+    def observe_window(self, step: int, phase_totals: Mapping[str, float],
+                       total_s: float, n_steps: int) -> Optional[Dict[str, Any]]:
+        """Collective: gather this process's window (summed phase seconds +
+        summed step seconds over `n_steps` completed steps), reduce to fleet
+        stats, publish gauges, and run the straggler detector.  Returns the
+        JSON-ready record the telemetry stream writes (or None when the
+        window is empty)."""
+        if n_steps <= 0:
+            return None
+        vec = np.asarray(  # host-sync-ok: building the gather payload from host floats
+            [n_steps, total_s]
+            + [phase_totals.get(p, 0.0) for p in PHASES],
+            dtype=np.float32,
+        )
+        # host-sync-ok: THE one deliberate log-cadence fleet gather/fetch
+        rows = np.asarray(self.gather_fn(vec), dtype=np.float64)
+        if rows.ndim != 2 or rows.shape[1] != vec.shape[0]:
+            return None
+        n_proc = rows.shape[0]
+        steps = np.maximum(rows[:, 0], 1.0)
+        step_means = rows[:, 1] / steps          # per-process mean step seconds
+        phase_means = rows[:, 2:] / steps[:, None]
+
+        med = float(np.median(step_means))
+        mx = float(np.max(step_means))
+        mn = float(np.min(step_means))
+        slowest = int(np.argmax(step_means))
+        skew_ratio = float(mx / max(med, _EPS))
+
+        reg = self.registry
+        reg.gauge("fleet/processes").set(n_proc)
+        reg.gauge("fleet/step_time_median_s").set(med)
+        reg.gauge("fleet/step_time_max_s").set(mx)
+        reg.gauge("fleet/step_time_min_s").set(mn)
+        reg.gauge("fleet/step_skew_ratio").set(skew_ratio)
+        reg.gauge("fleet/slowest_process").set(slowest)
+        phases_rec: Dict[str, Dict[str, float]] = {}
+        for i, name in enumerate(PHASES):
+            col = phase_means[:, i]
+            pm = {
+                "max": float(np.max(col)),
+                "min": float(np.min(col)),
+                "median": float(np.median(col)),
+                "argmax": int(np.argmax(col)),
+            }
+            phases_rec[name] = pm
+            reg.gauge(f"fleet/{name}_max_s").set(pm["max"])
+            reg.gauge(f"fleet/{name}_median_s").set(pm["median"])
+
+        alarms = self._detect_stragglers(step, step_means, med)
+        self.windows += 1
+
+        rec: Dict[str, Any] = {
+            "processes": n_proc,
+            "window_steps": int(steps[self.process_index] if self.process_index < n_proc
+                                else steps[0]),
+            "step_time": {"median_s": med, "max_s": mx, "min_s": mn,
+                          "per_process_s": [round(v, 6) for v in step_means.tolist()]},
+            "skew_ratio": round(skew_ratio, 4),
+            "slowest_process": slowest,
+            "phases": phases_rec,
+        }
+        if self._median_ema is not None:
+            rec["median_ema_s"] = round(self._median_ema, 6)
+        if alarms:
+            rec["straggler_alarms"] = alarms
+        return rec
+
+    # -- straggler detection -------------------------------------------------
+    def _detect_stragglers(self, step: int, step_means: np.ndarray,
+                           median: float) -> List[Dict[str, Any]]:
+        baseline = median if self._median_ema is None else self._median_ema
+        alarms: List[Dict[str, Any]] = []
+        for p, t in enumerate(step_means.tolist()):
+            slow = (t > self.skew_factor * max(median, _EPS)
+                    and t > self.skew_factor * max(baseline, _EPS))
+            if slow:
+                self._streaks[p] = self._streaks.get(p, 0) + 1
+                if (self._streaks[p] >= self.patience
+                        and not self._alarmed.get(p)):
+                    self._alarmed[p] = True
+                    self.alarms += 1
+                    alarm = {
+                        "type": "straggler", "step": step, "process": p,
+                        "step_time_s": round(t, 6),
+                        "fleet_median_s": round(median, 6),
+                        "median_ema_s": round(baseline, 6),
+                        "ratio": round(t / max(median, _EPS), 3),
+                        "windows": self._streaks[p],
+                    }
+                    self.registry.counter("fleet/straggler_alarms").inc()
+                    if self.on_alarm is not None:
+                        try:
+                            self.on_alarm(alarm)
+                        except Exception:  # telemetry must not kill training
+                            pass
+                    alarms.append(alarm)
+            else:
+                self._streaks[p] = 0
+                self._alarmed[p] = False
+        self.registry.gauge("fleet/straggler_streak_max").set(
+            max(self._streaks.values(), default=0)
+        )
+        # the EMA tracks the fleet MEDIAN (a straggler barely moves it on
+        # fleets of >2; on tiny fleets the ratio-to-median condition guards)
+        self._median_ema = (
+            median if self._median_ema is None
+            else self.ema_decay * self._median_ema + (1.0 - self.ema_decay) * median
+        )
+        return alarms
+
+    # -- persistence (parity with DivergenceMonitor) -------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "median_ema": self._median_ema,
+            "streaks": {str(k): v for k, v in self._streaks.items()},
+            # without this a restored mid-episode straggler would re-fire
+            # its "once per episode" alarm on the first window after resume
+            "alarmed": sorted(p for p, a in self._alarmed.items() if a),
+            "windows": self.windows,
+        }
+
+    def load_state_dict(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        ema = state.get("median_ema")
+        self._median_ema = None if ema is None else float(ema)  # host-sync-ok: JSON meta parse
+        self._streaks = {int(k): int(v)  # host-sync-ok: JSON meta parse
+                         for k, v in (state.get("streaks") or {}).items()}
+        self._alarmed = {int(p): True  # host-sync-ok: JSON meta parse
+                         for p in (state.get("alarmed") or [])}
+        self.windows = int(state.get("windows", 0))
+
+
+def merge_step_records(streams: Mapping[int, List[Dict[str, Any]]]
+                       ) -> List[Dict[str, Any]]:
+    """Offline counterpart of the live aggregator: merge per-process span
+    streams ({process_index: [records]}) into per-step cross-host rows.  The
+    live gather needs every host up; this runs on whatever files made it to
+    disk — the post-mortem path tools/fleet_report.py renders."""
+    by_step: Dict[int, Dict[str, Any]] = {}
+    for pidx, records in streams.items():
+        for rec in records:
+            if rec.get("kind") != "step" or rec.get("step") is None:
+                continue
+            row = by_step.setdefault(rec["step"], {"step": rec["step"], "per_process": {}})
+            row["per_process"][pidx] = {
+                "dur_s": rec.get("dur_s") or 0.0,
+                "spans": rec.get("spans") or {},
+            }
+    out = []
+    for step in sorted(by_step):
+        row = by_step[step]
+        durs = {p: v["dur_s"] for p, v in row["per_process"].items()}
+        if durs:
+            mx = max(durs.values())
+            mn = min(durs.values())
+            row["max_s"] = mx
+            row["min_s"] = mn
+            row["skew_s"] = mx - mn
+            row["slowest_process"] = max(durs, key=durs.get)
+        out.append(row)
+    return out

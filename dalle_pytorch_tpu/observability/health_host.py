@@ -254,14 +254,16 @@ def inject_nan(tree: Any, pattern: str) -> Any:
 
 def make_alarm_writer(tele, registry=None):
     """`on_alarm` callback for DivergenceMonitor: bump the alarm counter and
-    mirror the alarm into the telemetry event stream (`kind: "alarm"`,
-    type-prefixed `health_*` — the same stream recompile/FLOPs alarms use)."""
+    route the alarm through the telemetry alarm hub (`kind: "alarm"`,
+    type-prefixed `health_*` — the same stream recompile/FLOPs/straggler
+    alarms use, and the one reactive listeners like the on-alarm
+    TraceTrigger subscribe to)."""
     def on_alarm(a):
         if registry is not None:
             registry.counter("health/alarms").inc()
         if tele is not None:
-            tele.spans.write_event(
-                "alarm", type=f"health_{a['type']}",
+            tele.alarm(
+                f"health_{a['type']}",
                 **{k: v for k, v in a.items() if k != "type"},
             )
     return on_alarm

@@ -42,15 +42,19 @@ def thread_stacks() -> str:
 class Heartbeat:
     def __init__(self, deadline_s: float, dir: Optional[str] = None,
                  recorder=None, registry=None, poll_s: Optional[float] = None,
-                 on_hang=None):
+                 on_hang=None, process_index: Optional[int] = None):
         """`recorder`: a SpanRecorder for last-span context + the JSONL hang
         event; `registry`: a MetricsRegistry for the state snapshot;
-        `on_hang(report_text, info)`: optional extra callback."""
+        `on_hang(report_text, info)`: optional extra callback;
+        `process_index`: stamped into the dump filename and header so a
+        multi-process run's hang reports triage from one shared directory
+        (which hosts hung, and at which step each one stopped)."""
         self.deadline_s = float(deadline_s)
         self.dir = Path(dir) if dir is not None else None
         self.recorder = recorder
         self.registry = registry
         self.on_hang = on_hang
+        self.process_index = process_index
         self.hangs = 0
         self.last_report: Optional[str] = None
         self._last_beat = time.monotonic()
@@ -92,9 +96,14 @@ class Heartbeat:
             "deadline_s": self.deadline_s,
             "last_step": self._last_step,
         }
+        proc = ""
+        if self.process_index is not None:
+            info["process_index"] = self.process_index
+            proc = f"; process {self.process_index}"
         lines = [
             f"=== HANG: no step completed in {gap:.1f}s "
-            f"(deadline {self.deadline_s}s); last step {self._last_step} ===",
+            f"(deadline {self.deadline_s}s); last step {self._last_step}"
+            f"{proc} ===",
             f"wall time: {time.strftime('%Y-%m-%d %H:%M:%S')}",
             "",
             "--- last completed spans ---",
@@ -120,7 +129,9 @@ class Heartbeat:
         print(report, file=sys.stderr, flush=True)
         if self.dir is not None:
             self.dir.mkdir(parents=True, exist_ok=True)
-            fname = self.dir / f"hang_{time.strftime('%Y%m%d_%H%M%S')}_step{self._last_step}.txt"
+            ptag = "" if self.process_index is None else f"_p{self.process_index}"
+            fname = (self.dir / f"hang_{time.strftime('%Y%m%d_%H%M%S')}"
+                     f"{ptag}_step{self._last_step}.txt")
             fname.write_text(report)
             info["report_path"] = str(fname)
         if self.recorder is not None:

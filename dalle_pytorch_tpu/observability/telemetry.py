@@ -53,6 +53,7 @@ class Telemetry:
     ):
         self.dir = Path(dir) if dir is not None else None
         self.run_name = run_name
+        self.process_index = process_index
         suffix = "" if process_index == 0 else f".p{process_index}"
         spans_path = (
             str(self.dir / f"{run_name}{suffix}.spans.jsonl")
@@ -60,11 +61,15 @@ class Telemetry:
         )
         self.spans = SpanRecorder(spans_path, mirror_profiler=mirror_profiler)
         self.registry = metrics_mod.REGISTRY
+        # the alarm hub: every alarm (recompile, flops/comms divergence,
+        # health, straggler, hang) flows through alarm() — one JSONL stream,
+        # and one place for reactive listeners (the on-alarm TraceTrigger)
+        self._alarm_listeners: list = []
         self.compile_watcher: Optional[CompileWatcher] = None
         if watch_compiles:
             self.compile_watcher = CompileWatcher(
-                on_recompile=lambda ev: self.spans.write_event(
-                    "alarm", type="recompile", **{k: v for k, v in ev.items() if k != "ts"}
+                on_recompile=lambda ev: self.alarm(
+                    "recompile", **{k: v for k, v in ev.items() if k != "ts"}
                 )
             ).start()
         self.heartbeat: Optional[Heartbeat] = None
@@ -74,13 +79,55 @@ class Telemetry:
                 dir=str(self.dir) if self.dir is not None else None,
                 recorder=self.spans,
                 registry=self.registry,
+                process_index=process_index,
+                # the hang event is already written by the monitor; notify
+                # the listeners only (a resolved hang captures the next steps)
+                on_hang=lambda report, info: self._notify_alarm("hang", info),
             ).start()
         self._flops_check = FlopsCrosscheck(
             1.0, rtol=flops_rtol,
-            on_alarm=lambda ev: self.spans.write_event("alarm", type="flops_divergence", **ev),
+            on_alarm=lambda ev: self.alarm("flops_divergence", **ev),
         )
+        self._comms_check = None  # comms.CommsCrosscheck, built on first use
+        # fleet aggregation (observability/fleet.py): per-step phase times
+        # accumulate here and are gathered across hosts at the flush cadence
+        self.fleet = None
+        self._window_steps = 0
+        self._window_total_s = 0.0
+        self._window_phases: Dict[str, float] = {}
         self._steps_seen = 0
         self._closed = False
+
+    # -- alarms --------------------------------------------------------------
+    def alarm(self, type: str, **fields):
+        """Write one `kind: "alarm"` record and notify listeners.  Every
+        alarm source routes through here so reactive consumers (the
+        TraceTrigger) see the same stream the JSONL keeps."""
+        self.spans.write_event("alarm", type=type, **fields)
+        self._notify_alarm(type, fields)
+
+    def _notify_alarm(self, type: str, fields):
+        for fn in self._alarm_listeners:
+            try:
+                fn(type, fields)
+            except Exception:  # listeners must never break the alarm path
+                pass
+
+    def add_alarm_listener(self, fn):
+        """`fn(type: str, fields: dict)` on every alarm (any thread)."""
+        self._alarm_listeners.append(fn)
+
+    def attach_fleet(self, aggregator):
+        """Wire a fleet.FleetAggregator: its window feeds from finish_step,
+        its gather runs inside flush(), and its straggler alarms join the
+        alarm stream (unless the aggregator already has its own sink)."""
+        if aggregator.on_alarm is None:
+            aggregator.on_alarm = lambda a: self.alarm(
+                a.get("type", "straggler"),
+                **{k: v for k, v in a.items() if k != "type"},
+            )
+        self.fleet = aggregator
+        return aggregator
 
     # -- spans --------------------------------------------------------------
     def span(self, name: str, aggregate: bool = False, **attrs):
@@ -90,9 +137,14 @@ class Telemetry:
         self.spans.start_step(n)
 
     def finish_step(self, n: int):
-        """Flush the step record, stamp the heartbeat, and arm the recompile
-        counter once the first step has completed (steady state)."""
-        self.spans.end_step()
+        """Flush the step record, stamp the heartbeat, feed the fleet
+        window, and arm the recompile counter once the first step has
+        completed (steady state)."""
+        summary = self.spans.end_step()
+        self._window_steps += 1
+        self._window_total_s += summary.get("dur_s") or 0.0
+        for name, v in (summary.get("spans") or {}).items():
+            self._window_phases[name] = self._window_phases.get(name, 0.0) + v
         self._steps_seen += 1
         if self.heartbeat is not None:
             self.heartbeat.beat(n)
@@ -124,10 +176,32 @@ class Telemetry:
         return _StepCtx()
 
     # -- metrics ------------------------------------------------------------
-    def flush(self, logger=None, step: Optional[int] = None) -> Dict[str, Any]:
-        """Sample memory gauges, snapshot the registry, and push it through
-        the MetricLogger (when given) + the telemetry JSONL."""
+    def flush(self, logger=None, step: Optional[int] = None,
+              fleet: bool = True) -> Dict[str, Any]:
+        """Sample memory gauges, run the fleet gather (when attached),
+        snapshot the registry, and push it through the MetricLogger (when
+        given) + the telemetry JSONL.  COLLECTIVE when a fleet aggregator is
+        attached on a multi-process run: every process must flush at the
+        same step cadence.  Pass fleet=False from paths the OTHER processes
+        may not be taking — preemption, rollback-abort, end-of-run — or the
+        lone flusher blocks forever in the all-gather."""
         record_memory_gauges()
+        if fleet and self.fleet is not None and self._window_steps:
+            phases = self._window_phases
+            total_s, n_steps = self._window_total_s, self._window_steps
+            self._window_phases, self._window_total_s, self._window_steps = {}, 0.0, 0
+            # the gather's own (one-off) allgather compile is telemetry's,
+            # not a training recompile
+            suspend = (self.compile_watcher.suspended()
+                       if self.compile_watcher is not None
+                       else contextlib.nullcontext())
+            try:
+                with suspend:
+                    rec = self.fleet.observe_window(step, phases, total_s, n_steps)
+            except Exception:  # the fleet gather must never kill training
+                rec = None
+            if rec:
+                self.spans.write_event("fleet", step=step, **rec)
         snap = self.registry.flush_to(logger, step=step)
         if snap:
             self.spans.write_event("metrics", step=step, metrics=snap)
@@ -135,9 +209,15 @@ class Telemetry:
 
     # -- XLA ----------------------------------------------------------------
     def crosscheck_flops(self, step_fn, args: Tuple, analytic_flops: float,
-                         label: str = "train_step") -> Optional[float]:
+                         label: str = "train_step",
+                         analytic_comms_bytes: Optional[float] = None
+                         ) -> Optional[float]:
         """Record XLA's FLOPs estimate for the step vs the analytic model;
-        feeds the persistent-divergence alarm.  Never raises."""
+        feeds the persistent-divergence alarm.  With `analytic_comms_bytes`
+        (the comms ledger total), the same cost analysis additionally feeds
+        the comms cross-check: bytes-accessed over analytic wire bytes, with
+        its own drift alarm (observability/comms.CommsCrosscheck).  Never
+        raises."""
         import contextlib as _ctx
 
         suspend = (self.compile_watcher.suspended()
@@ -153,6 +233,22 @@ class Telemetry:
             compiled_flops=ca["flops"], ratio=ratio,
             bytes_accessed=ca.get("bytes accessed"),
         )
+        bytes_accessed = ca.get("bytes accessed")
+        if analytic_comms_bytes and bytes_accessed:
+            from dalle_pytorch_tpu.observability.comms import CommsCrosscheck
+
+            if self._comms_check is None:
+                self._comms_check = CommsCrosscheck(
+                    float(analytic_comms_bytes), rtol=self._flops_check.rtol,
+                    on_alarm=lambda ev: self.alarm("comms_divergence", **ev),
+                )
+            self._comms_check.analytic_flops = float(analytic_comms_bytes)
+            comms_ratio = self._comms_check.check(bytes_accessed)
+            self.spans.write_event(
+                "comms_crosscheck", label=label,
+                analytic_comms_bytes=float(analytic_comms_bytes),
+                bytes_accessed=bytes_accessed, ratio=comms_ratio,
+            )
         return ratio
 
     def summary(self) -> Dict[str, Any]:

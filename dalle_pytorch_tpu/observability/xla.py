@@ -211,15 +211,40 @@ def device_memory_stats(device=None) -> Optional[Dict[str, float]]:
             if isinstance(v, (int, float))}
 
 
-def record_memory_gauges(device=None) -> Optional[Dict[str, float]]:
-    """Sample allocator stats into gauges; returns the sample (or None)."""
-    stats = device_memory_stats(device)
-    if stats is None:
+_MEMORY_GAUGE_KEYS = ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size")
+
+
+def record_memory_gauges(devices=None) -> Optional[Dict[str, float]]:
+    """Sample allocator stats into gauges for EVERY local device — one gauge
+    per device (`device{id}/bytes_in_use`) so a single hot chip is
+    attributable, plus the cross-device max (`device_bytes_in_use` — the
+    number a capacity alarm should watch — and its explicit
+    `..._max_across_devices` alias).  Returns {key: max across devices}, or
+    None where the backend exposes no allocator stats (CPU)."""
+    if devices is None:
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return None
+    elif not isinstance(devices, (list, tuple)):
+        devices = [devices]
+    maxes: Dict[str, float] = {}
+    for d in devices:
+        stats = device_memory_stats(d)
+        if stats is None:
+            continue
+        dev_id = getattr(d, "id", 0)
+        for key in _MEMORY_GAUGE_KEYS:
+            if key in stats:
+                metrics_mod.gauge(f"device{dev_id}/{key}").set(stats[key])
+                if key not in maxes or stats[key] > maxes[key]:
+                    maxes[key] = stats[key]
+    if not maxes:
         return None
-    for key in ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size"):
-        if key in stats:
-            metrics_mod.gauge(f"device_{key}").set(stats[key])
-    return stats
+    for key, v in maxes.items():
+        metrics_mod.gauge(f"device_{key}").set(v)
+        metrics_mod.gauge(f"device_{key}_max_across_devices").set(v)
+    return maxes
 
 
 def step_cost_analysis(step_fn: Callable, *args) -> Optional[Dict[str, float]]:
@@ -266,7 +291,14 @@ class FlopsCrosscheck:
     The two estimates measure different things (cost_analysis sees the VAE
     encode, remat recompute, and optimizer FLOPs the analytic model
     excludes), so the alarm triggers on DRIFT from the first observed ratio,
-    not on distance from 1.0."""
+    not on distance from 1.0.
+
+    Subclasses override the metric names to reuse the drift logic for other
+    measured-vs-analytic pairs (observability/comms.py cross-checks the
+    analytic comms ledger against cost_analysis bytes-accessed)."""
+
+    RATIO_GAUGE = "flops_compiled_over_analytic"
+    ALARM_COUNTER = "flops_divergence_alarms"
 
     def __init__(self, analytic_flops: float, rtol: float = 0.5,
                  persistence: int = 2,
@@ -285,7 +317,7 @@ class FlopsCrosscheck:
             return None
         ratio = measured_flops / self.analytic_flops
         self.last_ratio = ratio
-        metrics_mod.gauge("flops_compiled_over_analytic").set(ratio)
+        metrics_mod.gauge(self.RATIO_GAUGE).set(ratio)
         if self.baseline_ratio is None:
             self.baseline_ratio = ratio
             return ratio
@@ -297,7 +329,7 @@ class FlopsCrosscheck:
                 event = {"baseline_ratio": self.baseline_ratio, "ratio": ratio,
                          "drift": drift, "analytic_flops": self.analytic_flops,
                          "measured_flops": measured_flops}
-                metrics_mod.counter("flops_divergence_alarms").inc()
+                metrics_mod.counter(self.ALARM_COUNTER).inc()
                 if self.on_alarm is not None:
                     self.on_alarm(event)
         else:

@@ -25,6 +25,31 @@ def _shift_seq(x: jnp.ndarray, axis: int, amount: int = 1) -> jnp.ndarray:
     return sliced[tuple(idx)]
 
 
+@jax.custom_vjp
+def _ordered_after(x: jnp.ndarray, dep: jnp.ndarray) -> jnp.ndarray:
+    """`x`, with an XLA ordering edge making it depend on `dep`.
+
+    Semantically the identity on `x`, so the VJP passes the cotangent
+    straight through (and zero to `dep`, whose barrier output is unused) —
+    jaxlibs older than 0.4.38 have no differentiation rule for
+    optimization_barrier, and the barrier must not change gradients anyway."""
+    x2, _ = jax.lax.optimization_barrier((x, dep))
+    return x2
+
+
+def _ordered_after_fwd(x, dep):
+    # dep rides the residuals only to shape its zero cotangent; it is live
+    # in the forward anyway (XLA aliases it), so this costs no extra memory
+    return _ordered_after(x, dep), dep
+
+
+def _ordered_after_bwd(dep, g):
+    return g, jnp.zeros_like(dep)
+
+
+_ordered_after.defvjp(_ordered_after_fwd, _ordered_after_bwd)
+
+
 def token_shift(x: jnp.ndarray, seq_len: int, image_fmap_size: int) -> jnp.ndarray:
     """x: (batch, n, dim) where the layout is [text (text_len), image raster].
 
@@ -63,7 +88,7 @@ def token_shift(x: jnp.ndarray, seq_len: int, image_fmap_size: int) -> jnp.ndarr
     # in-process rendezvous (observed under sp x pp meshes).  The barrier
     # makes the second shift depend on the first so every device issues them
     # in the same order; on TPU (in-order execution) it costs nothing.
-    x2, _ = jax.lax.optimization_barrier((x, shift1))
+    x2 = _ordered_after(x, shift1)
     shiftf = _shift_seq(x2, 1, fmap)  # p-fmap: image 'row above'
 
     # where each (position, channel) reads from; uncovered cells are zero

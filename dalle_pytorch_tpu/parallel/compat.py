@@ -1,0 +1,59 @@
+"""jax API compatibility shims for the parallel stack.
+
+The code targets the current jax surface (`jax.shard_map`, `jax.lax.pcast`,
+`jax.sharding.get_mesh`); older jaxlibs (< 0.5) ship the same machinery
+under `jax.experimental.shard_map` with a different partial-manual spelling
+(`auto=frozenset(...)` instead of `axis_names={...}`) and no replication
+casts.  These wrappers pick the right spelling at import time so the ring
+and pipeline schedules run on both:
+
+* `shard_map(f, mesh=..., in_specs=..., out_specs=..., axis_names=None)` —
+  `axis_names={'pp'}` means manual ONLY over those axes (the rest stay
+  automatic); on old jax that maps to `auto = mesh axes - axis_names` with
+  `check_rep=False` (replication tracking predates the varying-type system
+  and rejects partial-manual bodies the new checker accepts).
+* `pcast(x, axes, to='varying')` — the new varying-type cast.  Old shard_map
+  with `check_rep=False` has no varying/replicated distinction to satisfy,
+  so the cast is the identity there.
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+else:  # pragma: no cover - exercised only on old jaxlibs
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None):
+        auto = (frozenset(mesh.axis_names) - set(axis_names)
+                if axis_names is not None else frozenset())
+        auto = frozenset(a for a in auto if mesh.shape[a] > 1)
+        if auto:
+            # partial-manual bodies on the old partitioner either reject
+            # PartitionId or hard-ABORT on mixed manual/auto collectives —
+            # fail at trace time with a clear message instead (a SIGABRT
+            # inside a test run takes the whole session down with it)
+            raise NotImplementedError(
+                "partial-manual shard_map (manual over "
+                f"{sorted(set(axis_names))}, automatic over {sorted(auto)}) "
+                f"requires jax >= 0.5; this jaxlib ({jax.__version__}) only "
+                "supports fully-manual shard_map bodies"
+            )
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:  # pragma: no cover - exercised only on old jaxlibs
+    def pcast(x, axes, to="varying"):
+        return x  # no varying/replicated tracking under check_rep=False

@@ -118,9 +118,11 @@ def active_mesh() -> Optional[Mesh]:
     mesh = _ACTIVE_MESH.get()
     if mesh is not None:
         return mesh
-    mesh = jax.sharding.get_mesh()
-    if not mesh.empty:
-        return mesh
+    get_mesh = getattr(jax.sharding, "get_mesh", None)
+    if get_mesh is not None:  # jax >= 0.5; older jaxlibs use the fallback below
+        mesh = get_mesh()
+        if not mesh.empty:
+            return mesh
     try:
         import warnings
 
@@ -147,6 +149,16 @@ def mesh_context(mesh: Mesh):
             yield mesh
     finally:
         _ACTIVE_MESH.reset(token)
+
+
+def axis_sizes(mesh) -> dict:
+    """{axis: size} for a Mesh — or a plain mapping passed through (the comms
+    model prices hypothetical meshes from their shape alone, no devices
+    needed).  Unnamed axes default to 1 on lookup, so callers can ask for any
+    of MESH_AXES regardless of how the mesh was built."""
+    if isinstance(mesh, Mesh):
+        return dict(mesh.shape)
+    return dict(mesh)
 
 
 def make_mesh(cfg: MeshConfig = MeshConfig(), devices: Optional[Sequence] = None) -> Mesh:

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
+from dalle_pytorch_tpu.parallel.compat import pcast, shard_map
 from dalle_pytorch_tpu.parallel.mesh import AXIS_PP
 
 P = PartitionSpec
@@ -84,6 +85,22 @@ def default_num_micro(batch: int, stages: int) -> int:
 
 def _is_float(leaf) -> bool:
     return jnp.issubdtype(jnp.result_type(leaf), jnp.inexact)
+
+
+def pipeline_comm_bytes(batch: int, seq: int, dim: int, stages: int,
+                        num_micro: Optional[int] = None, itemsize: int = 4,
+                        interleave: int = 1,
+                        include_backward: bool = True) -> float:
+    """Per-device wire bytes for one pipeline_scan call: every tick moves one
+    microbatch-chunk activation ((batch/M, seq, dim)) through the stage-hop
+    ppermute, in the forward (T = v*M + P - 1 ticks) and again in the
+    explicit-backward tick scan.  The comms ledger (observability/comms.py)
+    prices pp traffic with this — keep it in lockstep with the schedule."""
+    if num_micro is None:
+        num_micro = default_num_micro(batch, stages)
+    ticks = interleave * num_micro + stages - 1
+    hop = float(batch // num_micro) * seq * dim * itemsize
+    return ticks * hop * (2.0 if include_backward else 1.0)
 
 
 def pipeline_scan(
@@ -259,7 +276,7 @@ def pipeline_scan(
                 h = jax.lax.ppermute(h, axis, fwd_perm)
             return (h, outs, saved, ring), None
 
-        var = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+        var = lambda z: pcast(z, (axis,), to="varying")
         h0 = var(jnp.zeros_like(xm_in[0]))
         outs0 = var(jnp.zeros_like(xm_in))
         ring0 = outs0 if v > 1 else h0  # dummy when not interleaved
@@ -278,7 +295,7 @@ def pipeline_scan(
         return out
 
     def fwd_only(fl_, il_, xm_):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda a, b, c: per_stage_fwd(a, b, c, with_saved=False),
             mesh=mesh,
             in_specs=(specs_like(fl_), specs_like(il_), P()),
@@ -288,7 +305,7 @@ def pipeline_scan(
         return fn(fl_, il_, xm_)
 
     def fwd_saving(fl_, il_, xm_):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda a, b, c: per_stage_fwd(a, b, c, with_saved=True),
             mesh=mesh,
             in_specs=(specs_like(fl_), specs_like(il_), P()),
@@ -375,7 +392,7 @@ def pipeline_scan(
                 dh = jax.lax.ppermute(dh, axis, bwd_perm)
             return (dh, dfl, dx, dring), None
 
-        var = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+        var = lambda z: pcast(z, (axis,), to="varying")
         dh0 = var(jnp.zeros_like(g[0]))
         # fl_local arrives P(axis)-sharded, i.e. already pp-varying — its
         # zeros need no pcast (g is replicated, so its derivatives do)
@@ -400,7 +417,7 @@ def pipeline_scan(
 
     def run_bwd(res, g):
         fl_, il_, saved = res
-        fn = jax.shard_map(
+        fn = shard_map(
             per_stage_bwd,
             mesh=mesh,
             in_specs=(specs_like(fl_), specs_like(il_), P(axis), P()),

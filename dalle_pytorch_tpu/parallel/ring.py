@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from dalle_pytorch_tpu.parallel.compat import shard_map
 from dalle_pytorch_tpu.parallel.mesh import AXIS_SP
 
 P = PartitionSpec
@@ -171,6 +172,27 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
 _ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
+def ring_comm_bytes(batch: int, heads: int, seq_shard: int, dim_head: int,
+                    n_dev: int, itemsize: int = 4,
+                    include_backward: bool = True) -> float:
+    """Per-device wire bytes for ONE ring_attention call over an `n_dev` ring.
+
+    Forward: K and V blocks ((b, h, n_loc, d) each, in the input dtype) hop
+    n_dev - 1 times.  Backward: the (q, do, lse, delta, dq) packet rotates a
+    full cycle (n_dev hops — see _ring_vjp_bwd); q/do ride in the input
+    dtype, lse/delta/dq in f32.  This is the accounting the comms ledger
+    (observability/comms.py) prices sp traffic with — keep it in lockstep
+    with the schedules above."""
+    kv_block = float(batch * heads * seq_shard * dim_head * itemsize)
+    fwd = (n_dev - 1) * 2.0 * kv_block
+    if not include_backward:
+        return fwd
+    f32_block = float(batch * heads * seq_shard * dim_head * 4)
+    scalar_block = float(batch * heads * seq_shard * 4)  # (..., 1) f32
+    packet = 2.0 * kv_block + f32_block + 2.0 * scalar_block
+    return fwd + n_dev * packet
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -193,7 +215,7 @@ def ring_attention(
         scale = q.shape[-1] ** -0.5
     spec = P(None, None, axis_name, None)
     if mask is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(_ring_attention_local, mask_rows=None, mask_cols=None,
                     axis_name=axis_name, causal=causal, scale=scale),
             mesh=mesh,
@@ -202,7 +224,7 @@ def ring_attention(
         )
         return fn(q, k, v)
     mask = jnp.asarray(mask, bool)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec, P(axis_name, None), P(None, axis_name)),

@@ -385,7 +385,10 @@ def make_train_step(
             return jitted(state, batch, key, with_health=with_health)
 
     # telemetry reaches through the closure: observability.step_cost_analysis
-    # lowers `.jitted` inside `.mesh`'s context for the XLA FLOPs cross-check
+    # lowers `.jitted` inside `.mesh`'s context for the XLA FLOPs cross-check,
+    # and the comms ledger (observability/comms.py) prices the collectives
+    # these settings made XLA emit
     with_mesh_ctx.jitted = jitted
     with_mesh_ctx.mesh = mesh
+    with_mesh_ctx.settings = settings
     return init_fn, with_mesh_ctx

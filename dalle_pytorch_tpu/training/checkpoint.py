@@ -390,7 +390,11 @@ def load_sharded(
     path = Path(directory).absolute()
     if template is None and only is not None:
         with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-            saved = ckptr.metadata(path / "state").item_metadata.tree
+            meta_obj = ckptr.metadata(path / "state")
+            # orbax API drift: newer releases wrap the tree in
+            # .item_metadata.tree; older ones return the tree/dict directly
+            item = getattr(meta_obj, "item_metadata", meta_obj)
+            saved = getattr(item, "tree", item)
             missing = [k for k in only if k not in saved]
             if missing:
                 raise KeyError(f"checkpoint {path} has no items {missing}; has {list(saved)}")
@@ -398,10 +402,16 @@ def load_sharded(
                 lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
                 {k: saved[k] for k in only},
             )
-            state = ckptr.restore(
-                path / "state",
-                args=ocp.args.PyTreeRestore(item=partial, partial_restore=True),
-            )
+            try:
+                state = ckptr.restore(
+                    path / "state",
+                    args=ocp.args.PyTreeRestore(item=partial, partial_restore=True),
+                )
+            except TypeError:
+                # old orbax: no partial_restore kwarg — restore the full
+                # tree and subset (loses the memory win, keeps correctness)
+                state = ckptr.restore(path / "state")
+                state = {k: state[k] for k in only}
     else:
         with ocp.StandardCheckpointer() as ckptr:
             if template is None:

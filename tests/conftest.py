@@ -20,3 +20,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    # tier-1 (ROADMAP.md) runs `-m 'not slow'`: multi-process / multi-minute
+    # tests carry these markers so the fast suite stays fast
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 fast suite (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers", "multichip: exercises multi-device or multi-process topology"
+    )

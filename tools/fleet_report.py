@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Merge per-process telemetry span files into cross-host fleet tables.
+
+    python tools/fleet_report.py /tmp/tele                 # picks *.spans.jsonl
+    python tools/fleet_report.py run.spans.jsonl run.p1.spans.jsonl ...
+
+For a multi-process run (each process writes `run.pN.spans.jsonl`) this
+renders the post-mortem view the live FleetAggregator publishes as gauges:
+
+* per-step cross-host table — each process's step time, the max-min skew,
+  and the slowest process per step (the skew timeline);
+* straggler ranking — mean step time per process, slowest first;
+* the comms ledger (analytic bytes/step per mesh axis + roofline) against
+  the measured cost_analysis cross-check;
+* fleet windows and every alarm from every process, process-tagged.
+
+Pure stdlib; tolerates torn tail lines from live runs and missing hosts
+(whatever made it to disk is merged — the live gather needs every host up,
+this does not)."""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+_PROC_RE = re.compile(r"\.p(\d+)\.spans\.jsonl$")
+
+
+def process_index_of(path: str) -> int:
+    """0 for `run.spans.jsonl`, N for `run.pN.spans.jsonl`."""
+    m = _PROC_RE.search(str(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_streams(paths: List[str]) -> Dict[int, List[Dict[str, Any]]]:
+    """{process_index: [records]} from span files and/or directories."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            found = sorted(p.glob("*.spans.jsonl"))
+            if not found:
+                raise SystemExit(f"no *.spans.jsonl under {p}")
+            files.extend(found)
+        else:
+            files.append(p)
+    streams: Dict[int, List[Dict[str, Any]]] = {}
+    for f in files:
+        records = []
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a live run
+        streams.setdefault(process_index_of(f), []).extend(records)
+    return streams
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.4f}" if v < 10 else f"{v:.2f}"
+
+
+def _merge_step_records(streams):
+    """observability/fleet.merge_step_records, importable from a bare
+    checkout (`python tools/fleet_report.py ...` without installing)."""
+    try:
+        from dalle_pytorch_tpu.observability.fleet import merge_step_records
+    except ImportError:
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from dalle_pytorch_tpu.observability.fleet import merge_step_records
+    return merge_step_records(streams)
+
+
+def build_report(streams: Dict[int, List[Dict[str, Any]]],
+                 max_rows: int = 40) -> str:
+    procs = sorted(streams)
+    rows = _merge_step_records(streams)
+    out: List[str] = []
+    out.append(f"fleet report: {len(procs)} process stream(s) "
+               f"({', '.join('p%d' % p for p in procs)})")
+
+    if rows:
+        header = (f"{'step':>6} "
+                  + " ".join(f"{'p%d s' % p:>10}" for p in procs)
+                  + f" {'skew_s':>10} {'slowest':>8}")
+        out.append("")
+        out.append("per-step cross-host step time (skew timeline)")
+        out.append(header)
+        out.append("-" * len(header))
+        shown = rows if len(rows) <= max_rows else (
+            rows[:max_rows // 2] + rows[-max_rows // 2:]
+        )
+        prev_step = None
+        for row in shown:
+            if prev_step is not None and row["step"] != prev_step + 1:
+                out.append(f"{'...':>6}")
+            prev_step = row["step"]
+            cells = [f"{row['step']:>6}"]
+            for p in procs:
+                v = row["per_process"].get(p)
+                cells.append(f"{_fmt_s(v['dur_s']):>10}" if v else f"{'-':>10}")
+            cells.append(f"{_fmt_s(row.get('skew_s', 0.0)):>10}")
+            cells.append(f"{'p%d' % row['slowest_process']:>8}"
+                         if "slowest_process" in row else f"{'-':>8}")
+            out.append(" ".join(cells))
+
+        # straggler ranking: mean step time per process, slowest first
+        sums: Dict[int, List[float]] = {p: [0.0, 0] for p in procs}
+        for row in rows:
+            for p, v in row["per_process"].items():
+                sums[p][0] += v["dur_s"]
+                sums[p][1] += 1
+        out.append("")
+        out.append("straggler ranking (mean step seconds, slowest first)")
+        ranked = sorted(
+            ((p, t / n if n else 0.0, n) for p, (t, n) in sums.items()),
+            key=lambda x: -x[1],
+        )
+        best = min((m for _, m, n in ranked if n), default=0.0)
+        for p, mean, n in ranked:
+            rel = f" ({mean / best:.2f}x fastest)" if best > 0 else ""
+            out.append(f"  p{p}: {_fmt_s(mean)}s over {n} steps{rel}")
+    else:
+        out.append("no step records found (run with telemetry enabled?)")
+
+    # comms ledger vs measured
+    ledgers = [r for recs in streams.values() for r in recs
+               if r.get("kind") == "comms_ledger"]
+    checks = [r for recs in streams.values() for r in recs
+              if r.get("kind") == "comms_crosscheck"]
+    if ledgers:
+        led = ledgers[-1]
+        out.append("")
+        mesh = " x ".join(f"{k}{v}" for k, v in led.get("mesh", {}).items()
+                          if v > 1) or "single-axis"
+        out.append(f"comms ledger (analytic wire bytes/step/chip, mesh {mesh})")
+        for row in led.get("per_axis", []):
+            out.append(f"  {row['axis']:<5} {row['op']:<26} "
+                       f"{row['bytes_per_step'] / 1e6:>10.3f} MB")
+        out.append(f"  {'total':<32} "
+                   f"{led.get('total_bytes_per_step', 0.0) / 1e6:>10.3f} MB")
+        roof = led.get("roofline")
+        if roof:
+            out.append(
+                f"  roofline: comms {roof['comms_s_at_peak'] * 1e3:.3f}ms vs "
+                f"compute {roof['compute_s_at_peak'] * 1e3:.3f}ms at peak "
+                f"-> {roof['bound']}-bound"
+            )
+    if checks:
+        c = checks[-1]
+        out.append(
+            f"  measured cross-check: cost_analysis bytes-accessed "
+            f"{c.get('bytes_accessed', 0) / 1e6:.1f} MB, "
+            f"ratio {c.get('ratio') and round(c['ratio'], 2)} "
+            "(drift of this ratio alarms, not its magnitude)"
+        )
+
+    # fleet windows (the live aggregator's view, as written to the stream)
+    fleets = [(p, r) for p, recs in streams.items() for r in recs
+              if r.get("kind") == "fleet"]
+    if fleets:
+        last = fleets[-1][1]
+        out.append("")
+        st = last.get("step_time", {})
+        out.append(
+            f"last fleet window (step {last.get('step')}): median "
+            f"{_fmt_s(st.get('median_s', 0.0))}s, max {_fmt_s(st.get('max_s', 0.0))}s, "
+            f"skew ratio {last.get('skew_ratio')}, slowest p{last.get('slowest_process')}"
+        )
+
+    out.append("")
+    alarms = [(p, r) for p, recs in streams.items() for r in recs
+              if r.get("kind") in ("alarm", "hang")]
+    if alarms:
+        out.append(f"ALARMS ({len(alarms)}):")
+        for p, a in alarms:
+            detail = {k: v for k, v in a.items() if k not in ("kind", "ts")}
+            out.append(f"  [p{p}][{a['kind']}] {detail}")
+    else:
+        out.append("alarms: none")
+    captures = [(p, r) for p, recs in streams.items() for r in recs
+                if r.get("kind") == "trace_capture"]
+    if captures:
+        out.append(f"profiler captures ({sum(1 for _, c in captures if c.get('action') == 'start')}):")
+        for p, c in captures:
+            out.append(f"  [p{p}] {c.get('action')} step={c.get('step')} "
+                       f"{c.get('reason', '')} {c.get('path', '')}".rstrip())
+    return "\n".join(out)
+
+
+def per_step_skew(streams: Dict[int, List[Dict[str, Any]]]) -> Dict[int, float]:
+    """{step: max-min step seconds across processes} — the column
+    tools/telemetry_report.py annotates its per-step table with."""
+    return {row["step"]: row.get("skew_s", 0.0)
+            for row in _merge_step_records(streams) if "skew_s" in row}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="+",
+                        help="span JSONL files and/or telemetry directories")
+    parser.add_argument("--max-rows", type=int, default=40,
+                        help="max per-step rows to print (head+tail beyond)")
+    args = parser.parse_args(argv)
+    try:
+        print(build_report(load_streams(args.paths), max_rows=args.max_rows))
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

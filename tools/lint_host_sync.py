@@ -50,6 +50,11 @@ JIT_PURE = (
     # train step; its deliberate host-side file/PRNG work is waived
     # line-by-line with host-sync-ok
     "dalle_pytorch_tpu/training/resilience.py",
+    # comms.py is pure shape arithmetic (must never touch device values);
+    # fleet.py syncs exactly once per log window — that one gather is
+    # waived, so any new sync sneaking into the per-step path stays visible
+    "dalle_pytorch_tpu/observability/comms.py",
+    "dalle_pytorch_tpu/observability/fleet.py",
 )
 
 WAIVER = "host-sync-ok"

@@ -3,14 +3,17 @@
 
     python tools/telemetry_report.py /tmp/tele/dalle.spans.jsonl
     python tools/telemetry_report.py /tmp/tele            # picks *.spans.jsonl
+    python tools/telemetry_report.py run.spans.jsonl run.p1.spans.jsonl ...
 
 For each step record it attributes wall-clock to the top-level spans
 (data_wait / dispatch / block / checkpoint / log / ...) and prints a
 percentage table plus an aggregate attribution, the aggregate-span stats
 (decode etc.), and any alarms (recompiles, FLOPs divergence, hangs) — the
 "data-starved, compile-thrashed, collective-bound, or kernel-bound?" answer
-in one screen.  Pure stdlib; works on a partially-written file from a live
-run."""
+in one screen.  With MULTIPLE `.pN` span files the per-step table gains a
+cross-process max-skew column (a thin wrapper over tools/fleet_report.py's
+merger; use fleet_report for the full cross-host view).  Pure stdlib for
+the single-file path; works on a partially-written file from a live run."""
 from __future__ import annotations
 
 import argparse
@@ -44,7 +47,8 @@ def _fmt_s(v: float) -> str:
     return f"{v:.4f}" if v < 10 else f"{v:.2f}"
 
 
-def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
+def build_report(records: List[Dict[str, Any]], max_rows: int = 40,
+                 skew_by_step: Dict[int, float] = None) -> str:
     steps = [r for r in records if r.get("kind") == "step" and r.get("step") is not None]
     alarms = [r for r in records if r.get("kind") in ("alarm", "hang")]
     checks = [r for r in records if r.get("kind") == "flops_crosscheck"]
@@ -66,6 +70,10 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
         )
         cols = names + (["other"] if other_needed else [])
         header = f"{'step':>6} {'total_s':>8} " + " ".join(f"{n + ' %':>12}" for n in cols)
+        if skew_by_step is not None:
+            # cross-process max skew (multi-file invocation): max-min step
+            # seconds across every process that recorded this step
+            header += f" {'xproc skew_s':>13}"
         if healths:
             # health-summary column: global grad-norm on health steps, the
             # first offending layer path when the step went non-finite
@@ -91,6 +99,9 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
             if other_needed:
                 pct = 100.0 * max(total - accounted, 0.0) / total if total > 0 else 0.0
                 row.append(f"{pct:>11.1f}%")
+            if skew_by_step is not None:
+                sk = skew_by_step.get(s["step"])
+                row.append(f"{_fmt_s(sk):>13}" if sk is not None else f"{'-':>13}")
             if healths:
                 h = healths.get(s["step"])
                 if h is None:
@@ -172,12 +183,28 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40) -> str:
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("path", help="spans JSONL file, or a telemetry directory")
+    parser.add_argument("path", nargs="+",
+                        help="spans JSONL file(s) (one per process for the "
+                             "cross-process skew column), or a telemetry "
+                             "directory")
     parser.add_argument("--max-rows", type=int, default=40,
                         help="max per-step rows to print (head+tail beyond)")
     args = parser.parse_args(argv)
+    skew = None
+    if len(args.path) > 1:
+        # multiple .pN files: annotate with cross-process skew via the
+        # fleet merger (tools/fleet_report.py); the table itself renders
+        # the FIRST file's attribution
+        try:
+            import fleet_report
+        except ImportError:
+            sys.path.insert(0, str(Path(__file__).resolve().parent))
+            import fleet_report
+
+        skew = fleet_report.per_step_skew(fleet_report.load_streams(args.path))
     try:
-        print(build_report(load_records(args.path), max_rows=args.max_rows))
+        print(build_report(load_records(args.path[0]), max_rows=args.max_rows,
+                           skew_by_step=skew))
     except BrokenPipeError:  # `| head` closed the pipe — not an error
         import os
 
