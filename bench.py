@@ -369,6 +369,46 @@ def main():
         "stall_reduction": round(1.0 - async_stall_s / max(sync_stall_s, 1e-9), 4),
     }
 
+    # HBM row (ISSUE 5): analytic per-chip ledger vs the compiled
+    # executable's own memory_analysis vs the live allocator peak, so
+    # BENCH_*.json tracks an HBM trajectory beside step time.  The
+    # memory_analysis costs one extra compile of the measured step.
+    from dalle_pytorch_tpu.observability import memory as memory_mod
+    from dalle_pytorch_tpu.observability.xla import device_memory_stats
+
+    mem_ledger = memory_mod.dalle_step_memory(
+        None, state.params, state.opt_state, cfg, batch, settings=settings
+    )
+    try:
+        mem_xla = memory_mod.step_memory_analysis(
+            step_fn, state, batch_data, jax.random.PRNGKey(400)
+        )
+    except Exception:
+        mem_xla = None
+    live = device_memory_stats()
+    memory_row = {
+        "analytic_mb": {r["name"]: round(r["bytes"] / 1e6, 2)
+                        for r in mem_ledger["rows"]},
+        "analytic_total_mb": round(mem_ledger["total_bytes"] / 1e6, 2),
+        "dominant": mem_ledger["dominant"],
+        "fits": mem_ledger["fits"],
+        "capacity_gb": (round(mem_ledger["capacity_bytes"] / 1e9, 1)
+                        if mem_ledger["capacity_bytes"] else None),
+        "xla_mb": ({k.replace("_bytes", ""): round(v / 1e6, 2)
+                    for k, v in mem_xla.items()} if mem_xla else None),
+        "xla_over_analytic": (
+            round(mem_xla["total_bytes"] / mem_ledger["total_bytes"], 4)
+            if mem_xla and mem_ledger["total_bytes"] else None
+        ),
+        "donation_ok": (memory_mod.audit_donation(
+            mem_xla,
+            sum(r["bytes"] for r in mem_ledger["rows"]
+                if r["name"] in ("params", "opt_state")),
+        )["ok"] if mem_xla else None),
+        "live_peak_mb": (round(live["peak_bytes_in_use"] / 1e6, 2)
+                         if live and "peak_bytes_in_use" in live else None),
+    }
+
     # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same
     # model; plus the FULL generate-images pipeline (codes -> VAE decode ->
     # CLIP scores), the generate.py-with-rerank path the BASELINE row names
@@ -548,6 +588,7 @@ def main():
         "comms": comms_row,
         "health_overhead": health_row,
         "async_checkpoint": async_checkpoint_row,
+        "memory": memory_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

@@ -16,6 +16,8 @@ from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
 from dalle_pytorch_tpu.models import vae_registry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts
+from dalle_pytorch_tpu.observability import memory as memory_mod
+from dalle_pytorch_tpu.training import resilience
 from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
 from dalle_pytorch_tpu.version import __version__
 
@@ -136,7 +138,44 @@ def main(argv=None):
     key = jax.random.PRNGKey(args.seed)
     outputs_dir = Path(args.outputs_dir)
 
+    # sampling-path HBM ledger: params + the KV cache the cached decode loop
+    # carries + the per-position logits — the numbers an OOM report needs
+    # (the KV cache is linear in --batch_size, the usual lever)
+    mem_ledger = memory_mod.sampling_memory_ledger(
+        dalle_cfg, args.batch_size, params
+    )
+
+    def oom_bail(e):
+        from dalle_pytorch_tpu.observability.xla import record_memory_gauges
+
+        try:
+            live = record_memory_gauges()
+        except Exception:
+            live = None
+        report = memory_mod.write_oom_report(
+            str(outputs_dir), error=e, phase="sampling", ledger=mem_ledger,
+            live_stats=live,
+            context={"batch_size": args.batch_size,
+                     "num_images": args.num_images,
+                     "cond_scale": args.cond_scale},
+        )
+        print(f"[memory] OUT OF MEMORY during sampling: forensic report -> "
+              f"{report or '<unwritable>'}; exiting with code "
+              f"{resilience.EXIT_OOM} (shrink --batch_size)", flush=True)
+        raise SystemExit(resilience.EXIT_OOM)
+
     paths = []
+    try:
+        return _generate_all(args, params, dalle_cfg, vae_params, vae_cfg,
+                             tokenizer, key, outputs_dir, paths)
+    except Exception as e:
+        if memory_mod.is_oom_error(e):
+            oom_bail(e)
+        raise
+
+
+def _generate_all(args, params, dalle_cfg, vae_params, vae_cfg, tokenizer,
+                  key, outputs_dir, paths):
     for raw_text in args.text.split("|"):
         raw_text = raw_text.strip()
         if args.gentxt:

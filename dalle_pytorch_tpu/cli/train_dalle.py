@@ -27,6 +27,7 @@ from dalle_pytorch_tpu.data.loader import (
 from dalle_pytorch_tpu.models import dalle as dalle_mod
 from dalle_pytorch_tpu.models import vae_registry
 from dalle_pytorch_tpu.observability import health_host as health_mod
+from dalle_pytorch_tpu.observability import memory as memory_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -256,6 +257,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "on THIS process — makes it a deliberate "
                              "straggler so the alarm + capture path can be "
                              "exercised end to end")
+    # memory observability (observability/memory.py)
+    parser.add_argument("--hbm_headroom_frac", type=float, default=0.9,
+                        metavar="FRAC",
+                        help="live-HBM headroom alarm: when bytes_in_use "
+                             "crosses FRAC x per-device capacity an "
+                             "'hbm_headroom' alarm fires (once per episode) "
+                             "and — with --profile_on_alarm — captures a "
+                             "profiler trace of the next steps.  0 disables. "
+                             "The analytic HBM ledger (mem/* gauges, "
+                             "kind:'mem_ledger' events, the XLA "
+                             "memory_analysis cross-check and donation "
+                             "audit) is always on under telemetry")
     # training-health diagnostics (observability/health.py)
     parser.add_argument("--health_every", type=int, default=0, metavar="N",
                         help="run the in-graph health diagnostic step every N "
@@ -338,7 +351,7 @@ def reconstitute_vae(args, resume=None):
 
 def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
                         global_step=0, wandb_run_id=None, health_state=None,
-                        data_state=None, fleet_state=None):
+                        data_state=None, fleet_state=None, memory_state=None):
     """(trees, meta) for a checkpoint — the device->host gather happens HERE
     (np.asarray inside to_host), so the result is a consistent snapshot that
     can be serialized later on the async writer thread.  `data_state`
@@ -362,13 +375,15 @@ def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
         "health_state": health_state,
         "data_state": data_state,
         "fleet_state": fleet_state,
+        "memory_state": memory_state,
     }
     return trees, meta
 
 
 def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
                global_step=0, wandb_run_id=None, health_state=None,
-               data_state=None, fleet_state=None, writer=None):
+               data_state=None, fleet_state=None, memory_state=None,
+               writer=None):
     """Gather + write one npz checkpoint.  With `writer` (an
     AsyncCheckpointWriter), only the gather runs here — serialization,
     fsync, atomic rename, and rotation happen on the writer thread and this
@@ -377,6 +392,7 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
         state, dalle_cfg, vae_params, vae_cfg, epoch, global_step=global_step,
         wandb_run_id=wandb_run_id, health_state=health_state,
         data_state=data_state, fleet_state=fleet_state,
+        memory_state=memory_state,
     )
     glob_pat = _rotation_glob(path) if keep_n is not None else None
     if writer is not None:
@@ -401,7 +417,8 @@ def _rotation_glob(path) -> str:
 
 def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                        keep_n=None, global_step=0, wandb_run_id=None,
-                       health_state=None, data_state=None, fleet_state=None):
+                       health_state=None, data_state=None, fleet_state=None,
+                       memory_state=None):
     """Distributed save: the TrainState goes through orbax, each host writing
     only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
     never gathered (`save_model`'s np.asarray would pull the full arrays to
@@ -421,6 +438,7 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
         "health_state": health_state,
         "data_state": data_state,
         "fleet_state": fleet_state,
+        "memory_state": memory_state,
     }
     path = Path(path)
     save_sharded(
@@ -773,10 +791,59 @@ def main(argv=None):
     mesh_cfg = MeshConfig(
         args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp, args.mesh_pp
     )
-    state, step_fn, _, _ = be.distribute(
-        loss_fn=loss_fn, params=start_params, optimizer=optimizer,
-        mesh_config=mesh_cfg, settings=settings,
+
+    # --- memory observability (observability/memory.py) --------------------
+    # The ledger is priced BEFORE distribution (placement itself can OOM) from
+    # the resolved mesh shape + start params (optimizer moments estimated),
+    # and refreshed from the live trees at the crosscheck site below.
+    try:
+        mem_axes = _dc.asdict(mesh_cfg.resolve(jax.device_count()))
+    except Exception:
+        mem_axes = {}
+    mem_ledger = memory_mod.dalle_step_memory(
+        mem_axes, start_params, None, dalle_cfg, args.batch_size,
+        settings=settings,
     )
+
+    def oom_bail(e, phase, step=None):
+        """RESOURCE_EXHAUSTED forensics: write oom_report_*.txt (ledger
+        breakdown, memory_analysis, live allocator stats, ranked
+        suggestions) and exit EXIT_OOM — the one exit code a supervisor
+        must NOT auto-restart (the same config will OOM again)."""
+        from dalle_pytorch_tpu.observability.xla import record_memory_gauges
+
+        report_dir = (args.telemetry if args.telemetry not in (None, "off")
+                      else f"{args.dalle_output_file_name}.telemetry")
+        try:
+            live = record_memory_gauges()
+        except Exception:
+            live = None
+        tele_now = telemetry.active()
+        path = memory_mod.write_oom_report(
+            report_dir, error=e, phase=phase, ledger=mem_ledger,
+            analysis=getattr(tele_now, "last_memory_analysis", None),
+            live_stats=live,
+            context={"global_step": step, "mesh": mem_ledger.get("mesh"),
+                     "batch_size": args.batch_size,
+                     "ga_steps": args.ga_steps,
+                     "zero_stage": args.zero_stage},
+            settings=settings, process_index=be.get_rank(),
+        )
+        print(f"[memory] OUT OF MEMORY during {phase}: forensic report -> "
+              f"{path or '<unwritable>'}; exiting with code "
+              f"{resilience.EXIT_OOM} (do not auto-restart this config)",
+              flush=True)
+        raise SystemExit(resilience.EXIT_OOM)
+
+    try:
+        state, step_fn, _, _ = be.distribute(
+            loss_fn=loss_fn, params=start_params, optimizer=optimizer,
+            mesh_config=mesh_cfg, settings=settings,
+        )
+    except Exception as e:
+        if memory_mod.is_oom_error(e):
+            oom_bail(e, "init")
+        raise
     if sharded_resume is not None:
         # restore shard-by-shard onto this run's state (its shardings define
         # the placement — the save mesh may have had a different shape)
@@ -836,6 +903,7 @@ def main(argv=None):
     tele = None
     fleet_agg = None
     capture = None
+    hbm_monitor = None
     if args.telemetry != "off":
         tele_dir = args.telemetry or f"{args.dalle_output_file_name}.telemetry"
         tele = telemetry.configure(
@@ -879,6 +947,28 @@ def main(argv=None):
             ).install_sigusr2()
             if args.profile_on_alarm:
                 tele.add_alarm_listener(capture.on_alarm)
+        # memory observability: publish the analytic HBM ledger (mem/*
+        # gauges + a kind:"mem_ledger" event with the fits verdict) and
+        # attach the live headroom monitor — its hbm_headroom alarm routes
+        # through the hub into the on-alarm profiler capture above
+        memory_mod.publish_gauges(mem_ledger, obs_metrics.REGISTRY)
+        tele.spans.write_event("mem_ledger", **mem_ledger)
+        if is_root:
+            fits = mem_ledger.get("fits")
+            verdict = ("fits" if fits else "DOES NOT FIT" if fits is not None
+                       else "capacity unknown")
+            print("[memory] analytic HBM ledger: "
+                  + ", ".join(f"{r['name']}={r['bytes'] / 1e9:.2f}GB"
+                              for r in mem_ledger["rows"])
+                  + f" per chip ({verdict}; dominant: {mem_ledger['dominant']};"
+                    " render with tools/memory_report.py)")
+        if args.hbm_headroom_frac:
+            hbm_monitor = tele.attach_memory(memory_mod.HbmMonitor(
+                headroom_frac=args.hbm_headroom_frac,
+            ))
+            # headroom-episode state survives restarts through checkpoint
+            # meta (DivergenceMonitor discipline)
+            hbm_monitor.load_state_dict((resume_meta or {}).get("memory_state"))
 
     # training-health diagnostics: per-layer numerics + divergence alarms on
     # a second jitted step every --health_every steps (observability/health)
@@ -951,6 +1041,8 @@ def main(argv=None):
         health_state = (health_monitor.state_dict()
                         if health_monitor is not None else None)
         fleet_state = (fleet_agg.state_dict() if fleet_agg is not None else None)
+        memory_state = (hbm_monitor.state_dict()
+                        if hbm_monitor is not None else None)
         with telemetry.span("checkpoint", path=str(path)):
             if args.sharded_checkpoint:
                 save_model_sharded(
@@ -958,14 +1050,16 @@ def main(argv=None):
                     keep_n=keep_n,
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
-                    data_state=ds, fleet_state=fleet_state)
+                    data_state=ds, fleet_state=fleet_state,
+                    memory_state=memory_state)
             else:
                 save_model(
                     path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                     keep_n=keep_n,
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
-                    data_state=ds, fleet_state=fleet_state, writer=writer)
+                    data_state=ds, fleet_state=fleet_state,
+                    memory_state=memory_state, writer=writer)
         obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
         if writer is None:
             # the async writer counts completions itself (checkpoints_saved)
@@ -1153,6 +1247,29 @@ def main(argv=None):
                                           for r in ledger["per_axis"])
                                       + f" per step ({ledger['roofline']['bound']}-bound "
                                         "at peak)")
+                            # HBM ledger refreshed from the LIVE trees (the
+                            # pre-distribution pricing estimated the
+                            # optimizer moments), cross-checked against the
+                            # compiled executable's memory_analysis — one
+                            # extra compile, shielded from the recompile
+                            # counter — including the donation audit
+                            mem_ledger = memory_mod.dalle_step_memory(
+                                getattr(step_fn, "mesh", None) or mem_axes,
+                                state.params, state.opt_state, dalle_cfg,
+                                int(device_batch["text"].shape[0]),
+                                settings=settings,
+                            )
+                            memory_mod.publish_gauges(
+                                mem_ledger, obs_metrics.REGISTRY)
+                            tele.spans.write_event("mem_ledger", **mem_ledger)
+                            mem_ratio = tele.crosscheck_memory(
+                                step_fn, (state, device_batch, sk), mem_ledger,
+                            )
+                            if is_root and mem_ratio is not None:
+                                print(f"[memory] xla/analytic HBM ratio: "
+                                      f"{mem_ratio:.3f} (analytic "
+                                      f"{mem_ledger['total_bytes'] / 1e9:.2f}GB"
+                                      f" per chip)")
                     health_step = bool(args.health_every) and (
                         global_step % args.health_every == 0
                     )
@@ -1360,6 +1477,8 @@ def main(argv=None):
                 health_monitor.load_state_dict(meta_rb.get("health_state"))
             if fleet_agg is not None:
                 fleet_agg.load_state_dict(meta_rb.get("fleet_state"))
+            if hbm_monitor is not None:
+                hbm_monitor.load_state_dict(meta_rb.get("memory_state"))
             if is_root:
                 print(f"[resilience] rolled back to {found} (attempt "
                       f"{rollback_attempts}/{args.rollback_retries}) after "
@@ -1372,6 +1491,15 @@ def main(argv=None):
                 writer.flush()
             if is_root:
                 logger.log_artifact(out_file, name="trained-dalle-final", metadata=dalle_cfg.to_dict())
+    except Exception as e:
+        # OOM forensics: RESOURCE_EXHAUSTED at compile time (the first
+        # dispatch) or at step time both land here — write the report
+        # (ledger + memory_analysis + live stats + suggestions), then exit
+        # EXIT_OOM through the finally cleanup below
+        if memory_mod.is_oom_error(e):
+            oom_bail(e, "compile" if first_window else "train_step",
+                     step=global_step)
+        raise
     finally:
         shutdown.uninstall()
         if capture is not None:

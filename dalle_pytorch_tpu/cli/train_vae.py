@@ -18,6 +18,7 @@ from dalle_pytorch_tpu.data.loader import ImageDataset, iterate_image_batches, p
 from dalle_pytorch_tpu.models import vae as vae_mod
 from dalle_pytorch_tpu.observability import health as health_pure
 from dalle_pytorch_tpu.observability import health_host as health_mod
+from dalle_pytorch_tpu.observability import memory as memory_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
@@ -85,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
                         metavar="SECONDS",
                         help="test hook: sleep this long inside every step "
                              "on THIS process (deliberate straggler)")
+    parser.add_argument("--hbm_headroom_frac", type=float, default=0.9,
+                        metavar="FRAC",
+                        help="live-HBM headroom alarm threshold (fraction of "
+                             "per-device capacity; 0 disables).  An OOM at "
+                             "compile or step time writes oom_report_*.txt "
+                             "and exits code 77")
     parser.add_argument("--health_every", type=int, default=0, metavar="N",
                         help="run the in-graph health diagnostic step every N "
                              "steps (0 disables): per-layer grad/param/update "
@@ -110,13 +117,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None,
-               fleet_state=None, writer=None):
+               fleet_state=None, memory_state=None, writer=None):
     """Gather + write the VAE checkpoint.  With `writer` (an
     AsyncCheckpointWriter) only the host gather runs here; serialization +
     fsync + rename happen on the writer thread."""
     trees = {"weights": to_host(params)}
     meta = {"hparams": cfg.to_dict(), "version": __version__,
-            "health_state": health_state, "fleet_state": fleet_state}
+            "health_state": health_state, "fleet_state": fleet_state,
+            "memory_state": memory_state}
     if writer is not None:
         writer.submit(path, trees, meta)
         return
@@ -227,6 +235,41 @@ def main(argv=None):
             if args.profile_on_alarm:
                 tele.add_alarm_listener(capture.on_alarm)
 
+    # memory observability: the VAE has no priced activation geometry (conv
+    # stacks), so the ledger is the tree-based LOWER bound — still enough to
+    # name the dominant row in an OOM report — plus the live headroom alarm
+    hbm_monitor = None
+    mem_ledger = memory_mod.generic_memory_ledger(params, opt_state)
+    if tele is not None:
+        memory_mod.publish_gauges(mem_ledger, obs_metrics.REGISTRY)
+        tele.spans.write_event("mem_ledger", **mem_ledger)
+        if args.hbm_headroom_frac:
+            hbm_monitor = tele.attach_memory(memory_mod.HbmMonitor(
+                headroom_frac=args.hbm_headroom_frac,
+            ))
+            hbm_monitor.load_state_dict((resume_meta or {}).get("memory_state"))
+
+    def oom_bail(e, phase):
+        from dalle_pytorch_tpu.observability.xla import record_memory_gauges
+
+        report_dir = (args.telemetry if args.telemetry not in (None, "off")
+                      else f"{args.vae_output_file_name}.telemetry")
+        try:
+            live = record_memory_gauges()
+        except Exception:
+            live = None
+        path = memory_mod.write_oom_report(
+            report_dir, error=e, phase=phase, ledger=mem_ledger,
+            live_stats=live,
+            context={"global_step": global_step, "batch_size": args.batch_size,
+                     "image_size": args.image_size},
+            process_index=be.get_rank(),
+        )
+        print(f"[memory] OUT OF MEMORY during {phase}: forensic report -> "
+              f"{path or '<unwritable>'}; exiting with code "
+              f"{resilience.EXIT_OOM}", flush=True)
+        raise SystemExit(resilience.EXIT_OOM)
+
     @functools.partial(jax.jit, static_argnames=("with_health",))
     def train_step(params, opt_state, images, key, temp, lr, with_health=False):
         def loss_fn(p):
@@ -284,6 +327,9 @@ def main(argv=None):
     def _fleet_state():
         return fleet_agg.state_dict() if fleet_agg is not None else None
 
+    def _memory_state():
+        return hbm_monitor.state_dict() if hbm_monitor is not None else None
+
     out_file = f"{args.vae_output_file_name}.pt"
     # async checkpoint writer + preemption-safe shutdown (training/resilience)
     writer = resilience.AsyncCheckpointWriter() if args.async_checkpoint else None
@@ -306,7 +352,8 @@ def main(argv=None):
         obs_metrics.counter("shutdown_requests").inc()
         if is_root:
             save_model(out_file, params, cfg, health_state=_health_state(),
-                       fleet_state=_fleet_state(), writer=writer)
+                       fleet_state=_fleet_state(),
+                       memory_state=_memory_state(), writer=writer)
         if writer is not None:
             writer.flush()
         if is_root:
@@ -420,7 +467,8 @@ def main(argv=None):
                         # + enqueue; serialize/fsync run on the writer thread
                         save_model(out_file, params, cfg,
                                    health_state=_health_state(),
-                                   fleet_state=_fleet_state(), writer=writer)
+                                   fleet_state=_fleet_state(),
+                                   memory_state=_memory_state(), writer=writer)
                     obs_metrics.histogram("checkpoint_save_s").observe(
                         time.perf_counter() - t_save
                     )
@@ -444,8 +492,15 @@ def main(argv=None):
             if is_root:
                 save_model(out_file, params, cfg,
                            health_state=_health_state(),
-                           fleet_state=_fleet_state(), writer=writer)
+                           fleet_state=_fleet_state(),
+                           memory_state=_memory_state(), writer=writer)
                 logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
+    except Exception as e:
+        # RESOURCE_EXHAUSTED at compile or step time: forensic report +
+        # EXIT_OOM (the finally below still drains the writer / handlers)
+        if memory_mod.is_oom_error(e):
+            oom_bail(e, "compile" if global_step == 0 else "train_step")
+        raise
     finally:
         # an exception mid-training must still drain queued async saves
         # (and surface their write errors) and restore the signal handlers

@@ -26,6 +26,19 @@ from dalle_pytorch_tpu.observability.health import (
     tree_health,
 )
 from dalle_pytorch_tpu.observability.health_host import DivergenceMonitor
+from dalle_pytorch_tpu.observability.memory import (
+    HbmMonitor,
+    MemoryCrosscheck,
+    audit_donation,
+    dalle_step_memory,
+    device_hbm_capacity,
+    is_oom_error,
+    oom_suggestions,
+    sampling_memory_ledger,
+    step_memory_analysis,
+    step_memory_ledger,
+    write_oom_report,
+)
 from dalle_pytorch_tpu.observability.heartbeat import Heartbeat, thread_stacks
 from dalle_pytorch_tpu.observability.metrics import (
     REGISTRY,
@@ -56,27 +69,38 @@ __all__ = [
     "DivergenceMonitor",
     "FleetAggregator",
     "FlopsCrosscheck",
+    "HbmMonitor",
     "Heartbeat",
+    "MemoryCrosscheck",
     "MetricsRegistry",
     "SpanRecorder",
     "Telemetry",
     "TraceTrigger",
     "active",
+    "audit_donation",
     "capture_taps",
     "comms_roofline",
     "configure",
     "counter",
     "dalle_step_comms",
+    "dalle_step_memory",
+    "device_hbm_capacity",
     "device_memory_stats",
     "gauge",
     "histogram",
+    "is_oom_error",
     "leaf_paths",
     "merge_step_records",
+    "oom_suggestions",
     "parse_profile_steps",
     "record_memory_gauges",
+    "sampling_memory_ledger",
     "span",
     "step_comms_ledger",
     "step_cost_analysis",
+    "step_memory_analysis",
+    "step_memory_ledger",
+    "write_oom_report",
     "tap",
     "tap_attention",
     "taps_active",

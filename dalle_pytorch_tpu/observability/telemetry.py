@@ -89,9 +89,16 @@ class Telemetry:
             on_alarm=lambda ev: self.alarm("flops_divergence", **ev),
         )
         self._comms_check = None  # comms.CommsCrosscheck, built on first use
+        self._mem_check = None    # memory.MemoryCrosscheck, built on first use
+        self.last_memory_analysis = None  # latest memory_analysis() dict —
+        # kept for the OOM forensic report (re-lowering at OOM time would
+        # just OOM again)
         # fleet aggregation (observability/fleet.py): per-step phase times
         # accumulate here and are gathered across hosts at the flush cadence
         self.fleet = None
+        # live HBM tracking (observability/memory.HbmMonitor): fed the
+        # allocator maxes record_memory_gauges samples inside flush()
+        self.memory = None
         self._window_steps = 0
         self._window_total_s = 0.0
         self._window_phases: Dict[str, float] = {}
@@ -128,6 +135,18 @@ class Telemetry:
             )
         self.fleet = aggregator
         return aggregator
+
+    def attach_memory(self, monitor):
+        """Wire a memory.HbmMonitor: flush() feeds it the live allocator
+        maxes, and its headroom alarms join the alarm stream (and so the
+        on-alarm TraceTrigger) unless the monitor has its own sink."""
+        if monitor.on_alarm is None:
+            monitor.on_alarm = lambda a: self.alarm(
+                a.get("type", "hbm_headroom"),
+                **{k: v for k, v in a.items() if k != "type"},
+            )
+        self.memory = monitor
+        return monitor
 
     # -- spans --------------------------------------------------------------
     def span(self, name: str, aggregate: bool = False, **attrs):
@@ -185,7 +204,14 @@ class Telemetry:
         same step cadence.  Pass fleet=False from paths the OTHER processes
         may not be taking — preemption, rollback-abort, end-of-run — or the
         lone flusher blocks forever in the all-gather."""
-        record_memory_gauges()
+        mem_stats = record_memory_gauges()
+        if self.memory is not None:
+            try:
+                rec = self.memory.observe(step, mem_stats)
+            except Exception:  # live tracking must never kill training
+                rec = None
+            if rec:
+                self.spans.write_event("mem_window", **rec)
         if fleet and self.fleet is not None and self._window_steps:
             phases = self._window_phases
             total_s, n_steps = self._window_total_s, self._window_steps
@@ -249,6 +275,58 @@ class Telemetry:
                 analytic_comms_bytes=float(analytic_comms_bytes),
                 bytes_accessed=bytes_accessed, ratio=comms_ratio,
             )
+        return ratio
+
+    def crosscheck_memory(self, step_fn, args: Tuple, ledger,
+                          label: str = "train_step",
+                          expected_donation_bytes: Optional[float] = None
+                          ) -> Optional[float]:
+        """Record XLA's `memory_analysis()` for the step vs the analytic
+        HBM ledger; feeds the persistent-drift alarm
+        (memory.MemoryCrosscheck) and — when the step declares
+        `donate_argnums` (or `expected_donation_bytes` is given) — the
+        donation audit, alarming `donation_dropped` through the hub when
+        the train state was not actually aliased.  COMPILES the step once
+        (shielded from the recompile counter); run at the crosscheck
+        cadence, not per step.  Never raises."""
+        import contextlib as _ctx
+
+        from dalle_pytorch_tpu.observability import memory as memory_mod
+
+        suspend = (self.compile_watcher.suspended()
+                   if self.compile_watcher is not None else _ctx.nullcontext())
+        with suspend:  # the crosscheck's own compile is not a recompile
+            analysis = memory_mod.step_memory_analysis(step_fn, *args)
+        if analysis is None:
+            return None
+        self.last_memory_analysis = analysis
+        analytic_total = (ledger or {}).get("total_bytes") or 0.0
+        ratio = None
+        if analytic_total > 0:
+            if self._mem_check is None:
+                self._mem_check = memory_mod.MemoryCrosscheck(
+                    analytic_total, rtol=self._flops_check.rtol,
+                    on_alarm=lambda ev: self.alarm("mem_divergence", **ev),
+                )
+            self._mem_check.analytic_flops = analytic_total
+            ratio = self._mem_check.check(analysis["total_bytes"])
+        event: Dict[str, Any] = {
+            "label": label, "analytic_total_bytes": analytic_total,
+            "ratio": ratio, **analysis,
+        }
+        if expected_donation_bytes is None and getattr(
+                step_fn, "donate_argnums", None):
+            # the step donates its TrainState (argument 0): expect the
+            # ledger's at-rest state rows (params + opt moments) aliased
+            rows = {r["name"]: r["bytes"] for r in (ledger or {}).get("rows", [])}
+            expected_donation_bytes = rows.get("params", 0.0) + rows.get(
+                "opt_state", 0.0)
+        if expected_donation_bytes:
+            audit = memory_mod.audit_donation(analysis, expected_donation_bytes)
+            event["donation"] = audit
+            if not audit["ok"]:
+                self.alarm("donation_dropped", label=label, **audit)
+        self.spans.write_event("memory_crosscheck", **event)
         return ratio
 
     def summary(self) -> Dict[str, Any]:

@@ -360,9 +360,15 @@ def make_train_step(
         return new_state, metrics
 
     if mesh is None:
-        return init_fn, jax.jit(
+        jitted_single = jax.jit(
             step_fn_inner, donate_argnums=0, static_argnames=("with_health",)
         )
+        # donation introspection: the memory observability stack
+        # (observability/memory.audit_donation) verifies that argument 0 —
+        # the TrainState — was actually aliased by the compiled executable
+        jitted_single.donate_argnums = (0,)
+        jitted_single.settings = settings
+        return init_fn, jitted_single
 
     batch_sh = NamedSharding(mesh, P(BATCH_AXES))
 
@@ -391,4 +397,7 @@ def make_train_step(
     with_mesh_ctx.jitted = jitted
     with_mesh_ctx.mesh = mesh
     with_mesh_ctx.settings = settings
+    # donation introspection for the memory stack's audit (argument 0, the
+    # TrainState, must come back aliased from memory_analysis)
+    with_mesh_ctx.donate_argnums = (0,)
     return init_fn, with_mesh_ctx

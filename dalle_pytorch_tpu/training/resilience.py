@@ -53,6 +53,7 @@ from dalle_pytorch_tpu.training import checkpoint as checkpoint_mod
 
 __all__ = [
     "EXIT_DIVERGED",
+    "EXIT_OOM",
     "EXIT_PREEMPTED",
     "AsyncCheckpointWriter",
     "CheckpointInvalidError",
@@ -83,6 +84,9 @@ __all__ = [
 # supervisor can `while run; rc=$?; [ $rc -eq 75 ] || break; done`
 EXIT_PREEMPTED = 75  # graceful preemption — safe to auto-restart
 EXIT_DIVERGED = 76   # rollback budget exhausted — needs a human
+EXIT_OOM = 77        # RESOURCE_EXHAUSTED — the config does not fit; see the
+#                      oom_report_*.txt the CLI wrote before exiting (do NOT
+#                      auto-restart: the same config will OOM again)
 
 
 # ---------------------------------------------------------------------------
@@ -543,6 +547,9 @@ FAULT_KINDS = (
     "truncate-checkpoint",  # cut the checkpoint saved at/after N in half
     "stall-data",         # sleep the data path at step N (hang-monitor food)
     "drop-remote-stream",  # sever a remote shard stream mid-read once
+    "oom",                # RESOURCE_EXHAUSTED at step N: real allocations on
+    #                       TPU, a faithfully-shaped simulated error on CPU —
+    #                       exercises the OOM forensic path (EXIT_OOM)
 )
 
 
@@ -608,6 +615,13 @@ class FaultInjector:
             print(f"[chaos] stalling data path {self.fault.stall_s}s at "
                   f"step {step}", flush=True)
             time.sleep(self.fault.stall_s)
+        elif kind == "oom":
+            self.fired = True
+            print(f"[chaos] provoking RESOURCE_EXHAUSTED at step {step}",
+                  flush=True)
+            from dalle_pytorch_tpu.observability.memory import provoke_oom
+
+            provoke_oom(simulate_reason=f"--inject_fault oom@{self.fault.step}")
 
     def wants_checkpoint_fault(self) -> bool:
         return not self.fired and self.fault.kind in (

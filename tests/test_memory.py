@@ -1,0 +1,620 @@
+"""Memory observability (ISSUE 5): the analytic HBM ledger's shard-pricing
+and remat-policy formulas, the XLA memory_analysis cross-check + donation
+audit, the live headroom alarm -> exactly one rate-limited capture, OOM
+forensics (report content + the `--inject_fault oom@STEP` CLI path ->
+EXIT_OOM), the report tools, and the HLO-identical guarantee with the
+memory stack active."""
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.observability import memory as mem_mod
+from dalle_pytorch_tpu.observability import telemetry as tele_mod
+from dalle_pytorch_tpu.observability.capture import TraceTrigger
+from dalle_pytorch_tpu.observability.metrics import MetricsRegistry
+from dalle_pytorch_tpu.parallel.mesh import MeshConfig, make_mesh
+from dalle_pytorch_tpu.parallel.train_step import StepSettings, make_train_step
+from dalle_pytorch_tpu.training import resilience
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32, depth=2, num_text_tokens=64, text_seq_len=8, heads=4, dim_head=8,
+        num_image_tokens=32, image_fmap_size=4,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def batch_for(cfg, b=8, seed=0):
+    kt, ki = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "text": jax.random.randint(kt, (b, cfg.text_seq_len), 0, cfg.num_text_tokens),
+        "image_codes": jax.random.randint(ki, (b, cfg.image_seq_len), 0, cfg.num_image_tokens),
+    }
+
+
+def dalle_loss(cfg):
+    def loss_fn(params, batch, key):
+        return dalle_mod.forward(
+            params, cfg, batch["text"], batch["image_codes"], return_loss=True
+        )
+
+    return loss_fn
+
+
+GEO = dict(batch=16, seq_len=64, dim=32, depth=4, heads=4, dim_head=8)
+
+
+def _ledger(axes, **kw):
+    base = dict(param_bytes=1e6, grad_bytes=1e6, opt_bytes=2e6, **GEO)
+    base.update(kw)
+    return mem_mod.step_memory_ledger(axes, **base)
+
+
+# --- shard-pricing formulas --------------------------------------------------
+
+def test_rest_shard_fraction():
+    axes = {"tp": 2, "pp": 2, "fsdp": 4}
+    # params: tp*pp always; fsdp only under ZeRO-3
+    assert mem_mod.rest_shard_fraction(axes, 0) == pytest.approx(1 / 4)
+    assert mem_mod.rest_shard_fraction(axes, 2) == pytest.approx(1 / 4)
+    assert mem_mod.rest_shard_fraction(axes, 3) == pytest.approx(1 / 16)
+    # moments: fsdp already under ZeRO-1
+    assert mem_mod.rest_shard_fraction(axes, 1, moments=True) == pytest.approx(1 / 16)
+    assert mem_mod.rest_shard_fraction(axes, 0, moments=True) == pytest.approx(1 / 4)
+    assert mem_mod.rest_shard_fraction({}, 3) == 1.0
+
+
+def test_ledger_rows_zero_stages_and_tp_pp():
+    rows0 = {r["name"]: r["bytes"] for r in _ledger({"fsdp": 4})["rows"]}
+    rows1 = {r["name"]: r["bytes"] for r in _ledger({"fsdp": 4}, zero_stage=1)["rows"]}
+    rows3 = {r["name"]: r["bytes"] for r in _ledger({"fsdp": 4}, zero_stage=3)["rows"]}
+    # ZeRO-0: everything replicated over fsdp; ZeRO-1 shards the moments;
+    # ZeRO-3 shards params + grads too
+    assert rows0["params"] == pytest.approx(1e6)
+    assert rows0["opt_state"] == pytest.approx(2e6)
+    assert rows1["params"] == pytest.approx(1e6)
+    assert rows1["opt_state"] == pytest.approx(2e6 / 4)
+    assert rows3["params"] == pytest.approx(1e6 / 4)
+    assert rows3["grads"] == pytest.approx(1e6 / 4)
+    assert rows3["opt_state"] == pytest.approx(2e6 / 4)
+    # tp/pp shard params at rest regardless of ZeRO
+    rows_tp = {r["name"]: r["bytes"] for r in _ledger({"tp": 2, "pp": 2})["rows"]}
+    assert rows_tp["params"] == pytest.approx(1e6 / 4)
+    assert rows_tp["opt_state"] == pytest.approx(2e6 / 4)
+
+
+def test_ledger_grad_accum_row_and_verdict():
+    led = _ledger({}, grad_accum=4, accum_bytes=3e6, capacity_bytes=1e9)
+    rows = {r["name"]: r["bytes"] for r in led["rows"]}
+    assert rows["grad_accum"] == pytest.approx(3e6)
+    assert led["fits"] is True and 0.9 < led["headroom_frac"] < 1.0
+    tight = _ledger({}, capacity_bytes=1e6)
+    assert tight["fits"] is False and tight["headroom_frac"] < 0
+    # no accum row without microbatching
+    assert "grad_accum" not in {r["name"] for r in _ledger({})["rows"]}
+    assert led["total_bytes"] == pytest.approx(sum(r["bytes"] for r in led["rows"]))
+
+
+# --- activation model --------------------------------------------------------
+
+def test_activation_remat_policy_ordering():
+    def act(execution, policy="full", flash=True):
+        return mem_mod.activation_bytes(
+            {}, **GEO, compute_itemsize=4, execution=execution,
+            remat_policy=policy, flash_attention=flash,
+        )["bytes"]
+
+    full = act("remat", "full")
+    flash = act("remat", "flash")
+    qkv = act("remat", "flash_qkv")
+    qkv_ff = act("remat", "flash_qkv_ff")
+    seq = act("sequential")
+    rev = act("reversible")
+    # each policy saves strictly more; keeping everything live is the most
+    assert full < flash < qkv < qkv_ff < seq
+    # reversible's boundary state is depth-independent (2 streams)
+    assert rev < full
+    # dense XLA attention materializes the (s, s) scores; flash never does
+    assert act("sequential", flash=False) > seq
+
+
+def test_activation_remat_full_exact_formula():
+    a = mem_mod.activation_bytes(
+        {}, **GEO, compute_itemsize=4, grad_accum=1,
+        execution="remat", remat_policy="full", flash_attention=True,
+    )
+    bsd = GEO["batch"] * GEO["seq_len"] * GEO["dim"] * 4
+    # one layer's live working set: qkv(3) + attn_out(1) + GEGLU ff (2*4) +
+    # misc(2) = 14 x bsd (no scores under flash; inner width == dim here)
+    assert a["layer_working_set_bytes"] == pytest.approx(14 * bsd)
+    assert a["saved_bytes"] == pytest.approx(GEO["depth"] * bsd)
+    assert a["bytes"] == pytest.approx(GEO["depth"] * bsd + 14 * bsd)
+
+
+def test_activation_attention_priced_at_inner_width():
+    # heads x dim_head = 2 x dim: the qkv/attention internals live at the
+    # INNER width, so they cost 2x what a dim-width pricing would say
+    wide = dict(GEO, dim_head=16)  # inner = 4*16 = 64 = 2*dim
+    a = mem_mod.activation_bytes(
+        {}, **wide, compute_itemsize=4, execution="remat",
+        remat_policy="full", flash_attention=True,
+    )
+    bsd = GEO["batch"] * GEO["seq_len"] * GEO["dim"] * 4
+    # qkv(3) + attn_out(1) at 2*bsd each -> 8 bsd; ff(8) + misc(2) at bsd
+    assert a["layer_working_set_bytes"] == pytest.approx(18 * bsd)
+
+
+def test_activation_microbatch_sp_and_pp_scaling():
+    kw = dict(**GEO, compute_itemsize=4, execution="remat",
+              remat_policy="full", flash_attention=True)
+    base = mem_mod.activation_bytes({}, **kw)
+    # grad_accum=4 shrinks the microbatch 4x -> activations scale down 4x
+    micro = mem_mod.activation_bytes({}, grad_accum=4, **kw)
+    assert micro["bytes"] == pytest.approx(base["bytes"] / 4)
+    assert micro["microbatch"] == GEO["batch"] // 4
+    # sp=4 shards the sequence 4x
+    sp = mem_mod.activation_bytes({"sp": 4}, **kw)
+    assert sp["bytes"] == pytest.approx(base["bytes"] / 4)
+    # pp=2: depth halves per stage but ~pp microbatches stay in flight
+    pp = mem_mod.activation_bytes({"pp": 2}, **kw)
+    assert pp["in_flight_microbatches"] == 2
+    bsd = GEO["batch"] * GEO["seq_len"] * GEO["dim"] * 4
+    assert pp["saved_bytes"] == pytest.approx(GEO["depth"] // 2 * bsd)
+
+
+# --- live-tree pricing -------------------------------------------------------
+
+class _Cfg:
+    total_seq_len, dim, depth, heads, dim_head = 64, 32, 4, 4, 8
+    remat_policy = "full"
+    attn_kernel = "xla"
+    pp_num_micro = None
+
+
+def test_dalle_step_memory_from_live_trees():
+    params = {"w": jnp.ones((64, 64), jnp.float32),
+              "b": jnp.ones((64,), jnp.bfloat16),
+              "ids": jnp.ones((4,), jnp.int32)}  # non-float: not counted
+    led = mem_mod.dalle_step_memory(
+        {"tp": 2}, params, None, _Cfg(), 16,
+        settings=StepSettings(grad_dtype=jnp.bfloat16),
+    )
+    rows = {r["name"]: r["bytes"] for r in led["rows"]}
+    param_bytes = 64 * 64 * 4 + 64 * 2
+    grad_bytes = (64 * 64 + 64) * 2
+    assert rows["params"] == pytest.approx(param_bytes / 2)
+    assert rows["grads"] == pytest.approx(grad_bytes / 2)
+    # no opt_state given -> priced as adam (2 f32 moments per param)
+    assert rows["opt_state"] == pytest.approx(2 * (64 * 64 + 64) * 4 / 2)
+    assert rows["activations"] > 0
+    # a real opt tree replaces the estimate
+    opt = {"mu": jnp.ones((64, 64), jnp.float32)}
+    led2 = mem_mod.dalle_step_memory({"tp": 2}, params, opt, _Cfg(), 16)
+    rows2 = {r["name"]: r["bytes"] for r in led2["rows"]}
+    assert rows2["opt_state"] == pytest.approx(64 * 64 * 4 / 2)
+    # mesh=None prices a single chip (NOT a no-op: single-chip runs OOM too)
+    led1 = mem_mod.dalle_step_memory(None, params, opt, _Cfg(), 16)
+    assert led1["mesh"] == {}
+    # settings.param_dtype reprices the (still-f32) start params at the
+    # dtype init_fn WILL store them in — the pre-distribution verdict must
+    # see the halved row
+    f32_tree = {"w": jnp.ones((64, 64), jnp.float32)}
+    led_bf16 = mem_mod.dalle_step_memory(
+        None, f32_tree, opt, _Cfg(), 16,
+        settings=StepSettings(param_dtype=jnp.bfloat16))
+    rows_bf16 = {r["name"]: r["bytes"] for r in led_bf16["rows"]}
+    assert rows_bf16["params"] == pytest.approx(64 * 64 * 2)
+
+
+def test_sampling_memory_ledger_kv_bytes():
+    cfg = tiny_cfg()
+    params = {"w": jnp.ones((8, 8), jnp.bfloat16)}
+    led = mem_mod.sampling_memory_ledger(cfg, 4, params)
+    rows = {r["name"]: r["bytes"] for r in led["rows"]}
+    # cache rides the param dtype (bf16 -> 2 bytes)
+    assert rows["kv_cache"] == pytest.approx(
+        2 * cfg.depth * 4 * cfg.total_seq_len * cfg.heads * cfg.dim_head * 2
+    )
+    assert rows["logits"] == pytest.approx(4 * cfg.total_tokens * 4)
+    assert rows["params"] == pytest.approx(8 * 8 * 2)
+
+
+def test_generic_ledger_is_labelled_lower_bound():
+    led = mem_mod.generic_memory_ledger({"w": jnp.ones((16, 16))})
+    assert led["lower_bound"] is True
+    assert "LOWER bound" in mem_mod.format_ledger(led)
+
+
+# --- XLA memory_analysis + donation audit ------------------------------------
+
+def _toy_step(donate=True):
+    def loss(p, b, k):
+        return jnp.sum((b["x"] @ p["w"]) ** 2)
+
+    init_fn, step_fn = make_train_step(loss, optax.adam(1e-3))
+    state = init_fn({"w": jnp.ones((64, 64), jnp.float32)})
+    batch = {"x": jnp.ones((8, 64), jnp.float32)}
+    if not donate:
+        bare = jax.jit(lambda s, b, k: step_fn(s, b, k))
+        return bare, state, batch
+    return step_fn, state, batch
+
+
+def test_memory_analysis_and_donation_audit():
+    step_fn, state, batch = _toy_step()
+    assert step_fn.donate_argnums == (0,)
+    ana = mem_mod.step_memory_analysis(step_fn, state, batch, jax.random.PRNGKey(0))
+    assert ana is not None and ana["argument_bytes"] > 0
+    state_bytes = 3 * 64 * 64 * 4  # params + adam mu + nu
+    audit = mem_mod.audit_donation(ana, state_bytes)
+    assert audit["ok"] and audit["donated_frac"] > 0.9
+
+    # a jit WITHOUT donation aliases nothing -> the audit alarms
+    bare, state, batch = _toy_step(donate=False)
+    ana2 = mem_mod.step_memory_analysis(bare, state, batch, jax.random.PRNGKey(0))
+    audit2 = mem_mod.audit_donation(ana2, state_bytes)
+    assert not audit2["ok"] and audit2["donated_bytes"] == 0.0
+
+
+def test_telemetry_crosscheck_memory_events_and_donation_alarm(tmp_path):
+    tele = tele_mod.configure(dir=str(tmp_path), run_name="mm",
+                              watch_compiles=False)
+    alarms = []
+    tele.add_alarm_listener(lambda t, f: alarms.append((t, f)))
+    try:
+        step_fn, state, batch = _toy_step()
+        led = mem_mod.generic_memory_ledger(state.params, state.opt_state)
+        ratio = tele.crosscheck_memory(
+            step_fn, (state, batch, jax.random.PRNGKey(0)), led)
+        assert ratio is not None and ratio > 0
+        assert tele.last_memory_analysis is not None
+
+        # non-donated executable + an explicit expectation -> donation alarm
+        bare, state2, batch2 = _toy_step(donate=False)
+        tele.crosscheck_memory(
+            bare, (state2, batch2, jax.random.PRNGKey(0)), led,
+            expected_donation_bytes=3 * 64 * 64 * 4)
+        assert any(t == "donation_dropped" for t, _ in alarms)
+    finally:
+        tele.close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "mm.spans.jsonl").read_text().splitlines()]
+    checks = [r for r in recs if r["kind"] == "memory_crosscheck"]
+    assert len(checks) == 2
+    assert checks[0]["donation"]["ok"] is True
+    assert checks[1]["donation"]["ok"] is False
+
+
+@pytest.mark.parametrize("name, mesh_cfg, cfg_kw, settings", [
+    ("dp", MeshConfig(dp=8), {}, StepSettings()),
+    # dim 128: the sharder only shards leaves >= 16 KiB (min_size), so the
+    # fsdp config must be wide enough that the tree's mass actually shards
+    # the way the ledger prices it (real configs are far past the cutoff)
+    ("fsdp_z3", MeshConfig(dp=1, fsdp=8), dict(dim=128),
+     StepSettings(zero_stage=3)),
+    ("tp", MeshConfig(dp=4, tp=2), {}, StepSettings()),
+    # pure pp (2 devices): the composed dp x fsdp x pp mesh needs jax >= 0.5
+    # partial-manual shard_map (parallel/compat.py) — same constraint as
+    # test_parallel's slow-marked composed-pipeline coverage
+    ("pp", MeshConfig(dp=1, pp=2),
+     dict(dim=128, depth=4, execution="remat", scan_layers=True,
+          pipeline_axis="pp"),
+     StepSettings()),
+])
+def test_ledger_agrees_with_memory_analysis(name, mesh_cfg, cfg_kw, settings):
+    """Acceptance: the analytic total and `compiled.memory_analysis()` stay
+    within the drift-alarm tolerance band on dp/fsdp/tp/pp configs (the two
+    measure different things — the cross-check alarms on drift, and this
+    pins the ratio to a sane band so the baseline ratio is meaningful)."""
+    cfg = tiny_cfg(**cfg_kw)
+    n_dev = mesh_cfg.dp * mesh_cfg.fsdp * mesh_cfg.tp * mesh_cfg.sp * mesh_cfg.pp
+    devices = jax.devices() if mesh_cfg.dp == -1 else jax.devices()[:n_dev]
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    init_fn, step_fn = make_train_step(
+        dalle_loss(cfg), optax.adam(1e-3), mesh=mesh, settings=settings)
+    state = init_fn(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    batch = batch_for(cfg, b=8)
+    led = mem_mod.dalle_step_memory(mesh, state.params, state.opt_state,
+                                    cfg, 8, settings=settings)
+    ana = mem_mod.step_memory_analysis(
+        step_fn, state, batch, jax.random.PRNGKey(0))
+    assert ana is not None, name
+    ratio = ana["total_bytes"] / led["total_bytes"]
+    assert 1 / 3 < ratio < 3, (name, ratio, led["total_bytes"], ana)
+    # a stable program must not trip the drift alarm on repeat checks
+    chk = mem_mod.MemoryCrosscheck(led["total_bytes"], rtol=0.5)
+    chk.check(ana["total_bytes"])
+    chk.check(ana["total_bytes"])
+    assert not chk.alarmed
+
+
+# --- live headroom -----------------------------------------------------------
+
+def test_hbm_monitor_alarm_once_per_episode_and_single_capture(tmp_path):
+    reg = MetricsRegistry()
+    tele = tele_mod.Telemetry(dir=str(tmp_path), run_name="hm",
+                              watch_compiles=False)
+    starts, stops = [], []
+    trigger = TraceTrigger(
+        dir=str(tmp_path / "traces"), window_steps=2,
+        start_fn=starts.append, stop_fn=lambda: stops.append(1),
+        clock=lambda: 0.0,  # frozen: the cooldown never expires
+    )
+    tele.add_alarm_listener(trigger.on_alarm)
+    mon = tele.attach_memory(mem_mod.HbmMonitor(
+        capacity_bytes=100.0, headroom_frac=0.9, registry=reg))
+    try:
+        hot = {"bytes_in_use": 95.0, "peak_bytes_in_use": 96.0}
+        rec = mon.observe(1, hot)
+        assert rec["alarmed"] and rec["usage_frac"] == pytest.approx(0.95)
+        assert mon.alarms == 1
+        # same episode: no re-fire
+        mon.observe(2, hot)
+        assert mon.alarms == 1
+        # the pending alarm capture runs for exactly its window
+        trigger.on_step_start(2)
+        trigger.on_step_end(2)
+        assert starts and not stops
+        trigger.on_step_end(3)
+        assert len(starts) == 1 and len(stops) == 1 and trigger.captures == 1
+        # recovery re-arms; the next episode alarms again but the capture is
+        # rate-limited (frozen clock -> cooldown active) -> suppressed
+        mon.observe(3, {"bytes_in_use": 10.0, "peak_bytes_in_use": 96.0})
+        mon.observe(4, hot)
+        assert mon.alarms == 2
+        trigger.on_step_start(5)
+        assert trigger.captures == 1 and trigger.suppressed == 1
+        # CPU (no allocator stats) degrades to a no-op
+        assert mon.observe(5, None) is None
+    finally:
+        tele.close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "hm.spans.jsonl").read_text().splitlines()]
+    assert sum(r["kind"] == "alarm" and r.get("type") == "hbm_headroom"
+               for r in recs) == 2
+
+
+def test_hbm_monitor_peak_delta_and_state_roundtrip():
+    reg = MetricsRegistry()
+    mon = mem_mod.HbmMonitor(capacity_bytes=1000.0, headroom_frac=0.9,
+                             on_alarm=lambda a: None, registry=reg)
+    mon.observe(1, {"peak_bytes_in_use": 100.0})
+    rec = mon.observe(2, {"peak_bytes_in_use": 160.0})
+    assert rec["peak_window_delta_bytes"] == pytest.approx(60.0)
+    mon.observe(3, {"bytes_in_use": 950.0, "peak_bytes_in_use": 960.0})
+    assert mon.alarmed
+    restored = mem_mod.HbmMonitor(capacity_bytes=1000.0, registry=reg)
+    restored.load_state_dict(mon.state_dict())
+    assert restored.alarmed and restored.last_peak == pytest.approx(960.0)
+    # a restored mid-episode monitor must NOT re-fire on the next sample,
+    # and its peak delta continues from the restored watermark
+    fired = []
+    restored.on_alarm = fired.append
+    rec = restored.observe(4, {"bytes_in_use": 950.0, "peak_bytes_in_use": 970.0})
+    assert not fired and rec["peak_window_delta_bytes"] == pytest.approx(10.0)
+    restored.load_state_dict(None)  # tolerated
+
+
+def test_telemetry_flush_feeds_monitor_without_device_stats():
+    # flush() on CPU (record_memory_gauges -> None) must not crash or emit
+    tele = tele_mod.Telemetry(dir=None, watch_compiles=False)
+    tele.attach_memory(mem_mod.HbmMonitor(capacity_bytes=1.0,
+                                          registry=MetricsRegistry()))
+    try:
+        tele.flush(None, step=0)
+    finally:
+        tele.close()
+
+
+# --- OOM forensics -----------------------------------------------------------
+
+def test_is_oom_error_matching_and_chain():
+    assert mem_mod.is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: 1GB"))
+    assert mem_mod.is_oom_error(RuntimeError("Ran out of memory in region"))
+    assert not mem_mod.is_oom_error(ValueError("shape mismatch"))
+    try:
+        try:
+            raise RuntimeError("RESOURCE_EXHAUSTED: inner")
+        except RuntimeError as inner:
+            raise ValueError("outer wrapper") from inner
+    except ValueError as e:
+        assert mem_mod.is_oom_error(e)
+
+
+def test_oom_suggestions_track_dominant_row():
+    def ledger_with(dominant, detail=""):
+        return {"dominant": dominant,
+                "rows": [{"name": dominant, "bytes": 1.0, "detail": detail}]}
+
+    s_opt = mem_mod.oom_suggestions(ledger_with("opt_state"),
+                                    settings=StepSettings(zero_stage=0))
+    assert "zero_stage" in s_opt[0]
+    s_act = mem_mod.oom_suggestions(ledger_with("activations", "sequential/full"))
+    assert "remat" in s_act[0]
+    s_act2 = mem_mod.oom_suggestions(
+        ledger_with("activations", "remat/flash_qkv"))
+    assert "remat_policy" in s_act2[0]
+    s_par = mem_mod.oom_suggestions(ledger_with("params"),
+                                    settings=StepSettings(zero_stage=3))
+    assert "bfloat16" in s_par[0]
+    assert all("zero_stage to 3" not in s for s in s_par)
+    # every list ends with the universal lever
+    assert "batch_size" in s_opt[-1]
+    # suggestions already in effect are filtered out
+    s_par_bf16 = mem_mod.oom_suggestions(
+        ledger_with("params"),
+        settings=StepSettings(param_dtype=jnp.bfloat16, zero_stage=3))
+    assert all("param_dtype" not in s for s in s_par_bf16)
+    s_grad_bf16 = mem_mod.oom_suggestions(
+        ledger_with("grads"), settings=StepSettings(grad_dtype=jnp.bfloat16))
+    assert all("grad_dtype" not in s for s in s_grad_bf16)
+    s_full = mem_mod.oom_suggestions(ledger_with("activations", "remat/full"))
+    assert "ga_steps" in s_full[0]
+    assert all("remat_policy" not in s for s in s_full)
+
+
+def test_write_oom_report_contents(tmp_path):
+    led = _ledger({"dp": 2, "fsdp": 4}, zero_stage=0, capacity_bytes=4e6)
+    path = mem_mod.write_oom_report(
+        str(tmp_path), error=RuntimeError("RESOURCE_EXHAUSTED: 12.3GB"),
+        phase="compile", ledger=led,
+        analysis={"argument_bytes": 1e6, "temp_bytes": 2e6, "alias_bytes": 5e5,
+                  "output_bytes": 1e6, "generated_code_bytes": 0.0,
+                  "total_bytes": 3.5e6},
+        live_stats={"bytes_in_use": 3e6, "peak_bytes_in_use": 3.9e6},
+        context={"global_step": 7},
+        process_index=1,
+    )
+    assert Path(path).name.startswith("oom_report_compile_p1_")
+    text = Path(path).read_text()
+    assert "RESOURCE_EXHAUSTED: 12.3GB" in text
+    assert "DOES NOT FIT" in text
+    assert "<-- dominant" in text and led["dominant"] in text
+    assert "memory_analysis" in text and "peak_bytes_in_use" in text
+    assert "suggestions (ranked" in text and "1." in text
+    assert "global_step: 7" in text
+
+
+def test_provoke_oom_simulates_on_cpu_and_kind_registered():
+    assert "oom" in resilience.FAULT_KINDS
+    fault = resilience.parse_fault("oom@5")
+    assert fault.kind == "oom" and fault.step == 5
+    with pytest.raises(Exception) as ei:
+        mem_mod.provoke_oom("unit test")
+    assert mem_mod.is_oom_error(ei.value)
+    inj = resilience.FaultInjector(fault)
+    inj.at_step(4)  # below the step: no fire
+    assert not inj.fired
+    with pytest.raises(Exception) as ei:
+        inj.at_step(5)
+    assert mem_mod.is_oom_error(ei.value) and inj.fired
+
+
+def test_cli_oom_injection_writes_forensic_report(tmp_path):
+    """Acceptance: an injected OOM exits EXIT_OOM and leaves an
+    oom_report_*.txt naming the dominant ledger row with at least one
+    applicable suggestion."""
+    from dalle_pytorch_tpu.cli import train_dalle as train_dalle_cli
+
+    out = tmp_path / "dalle"
+    with pytest.raises(SystemExit) as ei:
+        train_dalle_cli.main([
+            "--dummy_run", "3",
+            "--inject_fault", "oom@1",
+            "--dalle_output_file_name", str(out),
+        ])
+    assert ei.value.code == resilience.EXIT_OOM
+    reports = list((tmp_path / "dalle.telemetry").glob("oom_report_*.txt"))
+    assert len(reports) == 1
+    text = reports[0].read_text()
+    assert "RESOURCE_EXHAUSTED" in text
+    assert "<-- dominant" in text
+    assert "suggestions (ranked" in text
+    # the dummy config's dominant row is activations -> remat/microbatch
+    # levers must be offered
+    assert "activations" in text and ("remat" in text or "ga_steps" in text)
+    # the ledger + crosscheck landed in telemetry before the fault
+    recs = [json.loads(line) for line in
+            (tmp_path / "dalle.telemetry" / "dalle.spans.jsonl")
+            .read_text().splitlines()]
+    assert any(r["kind"] == "mem_ledger" for r in recs)
+    assert any(r["kind"] == "memory_crosscheck" for r in recs)
+
+
+# --- HLO-identical guarantee -------------------------------------------------
+
+def test_train_step_hlo_identical_with_memory_stack(tmp_path):
+    """The memory stack is host-side only: attaching the monitor, publishing
+    the ledger, and running the crosscheck must not change the training
+    executable's HLO by a single byte."""
+    cfg = tiny_cfg()
+    init_fn, step_fn = make_train_step(dalle_loss(cfg), optax.adam(1e-3))
+    state = init_fn(dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    batch = batch_for(cfg, b=4)
+    bare = step_fn.lower(state, batch, jax.random.PRNGKey(0)).as_text()
+
+    tele = tele_mod.configure(dir=str(tmp_path), run_name="hlo",
+                              watch_compiles=False)
+    try:
+        tele.attach_memory(mem_mod.HbmMonitor(capacity_bytes=16e9,
+                                              registry=MetricsRegistry()))
+        led = mem_mod.dalle_step_memory(None, state.params, state.opt_state,
+                                        cfg, 4)
+        mem_mod.publish_gauges(led, MetricsRegistry())
+        tele.crosscheck_memory(step_fn, (state, batch, jax.random.PRNGKey(0)),
+                               led)
+        tele.flush(None, step=0)
+        with_stack = step_fn.lower(state, batch, jax.random.PRNGKey(0)).as_text()
+    finally:
+        tele.close()
+    assert with_stack == bare
+
+
+# --- report tools ------------------------------------------------------------
+
+def _tool(name):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import importlib
+
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_memory_report_renders_ledger_crosscheck_and_timeline(tmp_path):
+    records = [
+        {"kind": "mem_ledger", "ts": 0.0,
+         **_ledger({"dp": 2}, capacity_bytes=16e9)},
+        {"kind": "memory_crosscheck", "ts": 0.0, "label": "train_step",
+         "analytic_total_bytes": 4e9, "ratio": 1.3,
+         "argument_bytes": 2e9, "temp_bytes": 2.5e9, "output_bytes": 2e9,
+         "alias_bytes": 2e9, "generated_code_bytes": 0.0, "total_bytes": 5.2e9,
+         "donation": {"donated_bytes": 2e9, "expected_bytes": 2e9,
+                      "donated_frac": 1.0, "ok": True}},
+        {"kind": "mem_window", "ts": 0.0, "step": 10,
+         "bytes_in_use": 9e9, "peak_bytes_in_use": 11e9,
+         "peak_window_delta_bytes": 1e9, "usage_frac": 0.56, "alarmed": False},
+        {"kind": "alarm", "ts": 0.0, "type": "hbm_headroom", "step": 12,
+         "usage_frac": 0.93},
+    ]
+    p = tmp_path / "run.spans.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    report = _tool("memory_report").build_report(
+        _tool("memory_report").load_records(str(p)))
+    assert "analytic HBM ledger" in report and "<-- dominant" in report
+    assert "FITS" in report
+    assert "xla/analytic=1.3" in report
+    assert "donation audit: OK" in report
+    assert "live HBM peak timeline" in report and "56.0%" in report
+    assert "[hbm_headroom]" in report
+
+
+def test_telemetry_report_gains_peak_hbm_column(tmp_path):
+    records = [
+        {"kind": "step", "step": 0, "dur_s": 1.0, "spans": {"dispatch": 0.9}},
+        {"kind": "step", "step": 1, "dur_s": 1.0, "spans": {"dispatch": 0.9}},
+        {"kind": "mem_window", "step": 1, "peak_bytes_in_use": 12.5e9},
+    ]
+    p = tmp_path / "run.spans.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    tr = _tool("telemetry_report")
+    report = tr.build_report(tr.load_records(str(p)))
+    assert "peak HBM GB" in report
+    assert "12.500" in report
+    # no memory data -> no column (old files render unchanged)
+    p2 = tmp_path / "bare.spans.jsonl"
+    p2.write_text(json.dumps(records[0]) + "\n")
+    assert "peak HBM" not in tr.build_report(tr.load_records(str(p2)))

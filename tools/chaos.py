@@ -6,9 +6,12 @@ Two ways to break a training run on purpose:
 
 * **In-process** — pass `--inject_fault KIND@STEP` to train_dalle/train_vae
   (kinds: kill-process, preempt, corrupt-checkpoint, truncate-checkpoint,
-  stall-data, drop-remote-stream; stall-data accepts `@STEP:SECONDS`).  The
-  training loop drives the fault at exactly the named step — this is what
-  the crash-and-resume equivalence tests use.
+  stall-data, drop-remote-stream, oom; stall-data accepts `@STEP:SECONDS`).
+  The training loop drives the fault at exactly the named step — this is
+  what the crash-and-resume equivalence tests use.  `oom@STEP` provokes a
+  RESOURCE_EXHAUSTED (real allocations on TPU, a faithfully-shaped
+  simulated error on CPU) so the OOM forensic path — oom_report_*.txt +
+  exit code 77 — is exercisable end to end.
 * **From outside** — this CLI damages artifacts or signals a live run:
 
       python tools/chaos.py corrupt  CKPT.npz      # garbage bytes into it

@@ -55,6 +55,17 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40,
     compile_summaries = [r for r in records if r.get("kind") == "compile_summary"]
     metrics = [r for r in records if r.get("kind") == "metrics"]
     healths = {r.get("step"): r for r in records if r.get("kind") == "health"}
+    # peak-HBM column: mem_window records (observability/memory.HbmMonitor)
+    # or the device_peak_bytes_in_use gauge — sampled at the flush cadence,
+    # so most steps show '-' and flush steps carry the number
+    peak_by_step: Dict[Any, float] = {}
+    for r in records:
+        if r.get("kind") == "mem_window" and r.get("peak_bytes_in_use") is not None:
+            peak_by_step[r.get("step")] = r["peak_bytes_in_use"]
+        elif r.get("kind") == "metrics":
+            rec = (r.get("metrics") or {}).get("device_peak_bytes_in_use")
+            if rec and rec.get("last") is not None:
+                peak_by_step.setdefault(r.get("step"), rec["last"])
 
     out: List[str] = []
     if not steps:
@@ -74,6 +85,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40,
             # cross-process max skew (multi-file invocation): max-min step
             # seconds across every process that recorded this step
             header += f" {'xproc skew_s':>13}"
+        if peak_by_step:
+            header += f" {'peak HBM GB':>12}"
         if healths:
             # health-summary column: global grad-norm on health steps, the
             # first offending layer path when the step went non-finite
@@ -102,6 +115,9 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 40,
             if skew_by_step is not None:
                 sk = skew_by_step.get(s["step"])
                 row.append(f"{_fmt_s(sk):>13}" if sk is not None else f"{'-':>13}")
+            if peak_by_step:
+                pk = peak_by_step.get(s["step"])
+                row.append(f"{pk / 1e9:>12.3f}" if pk is not None else f"{'-':>12}")
             if healths:
                 h = healths.get(s["step"])
                 if h is None:
