@@ -299,7 +299,8 @@ def main():
 
     comms_mesh = {"dp": 4, "tp": 2}
     comms_ledger = comms_mod.dalle_step_comms(
-        comms_mesh, state.params, cfg, batch, settings=settings
+        comms_mesh, state.params, cfg, batch, settings=settings,
+        registry=getattr(step_fn, "registry", None),
     )
     comms_row = {
         "mesh": comms_mesh,
@@ -377,7 +378,8 @@ def main():
     from dalle_pytorch_tpu.observability.xla import device_memory_stats
 
     mem_ledger = memory_mod.dalle_step_memory(
-        None, state.params, state.opt_state, cfg, batch, settings=settings
+        None, state.params, state.opt_state, cfg, batch, settings=settings,
+        registry=getattr(step_fn, "registry", None),
     )
     try:
         mem_xla = memory_mod.step_memory_analysis(

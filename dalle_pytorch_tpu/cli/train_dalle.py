@@ -7,6 +7,7 @@ gradient accumulation + bf16) instead of DeepSpeed/Horovod engines."""
 from __future__ import annotations
 
 import argparse
+import dataclasses as _dc
 import time
 from glob import glob
 from pathlib import Path
@@ -34,6 +35,7 @@ from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images
 from dalle_pytorch_tpu.models.vae import DiscreteVAEConfig
 from dalle_pytorch_tpu.parallel import backend as backend_mod
+from dalle_pytorch_tpu.parallel import registry as registry_mod
 from dalle_pytorch_tpu.parallel.mesh import MeshConfig
 from dalle_pytorch_tpu.parallel.train_step import StepSettings, TrainState
 from dalle_pytorch_tpu.training import resilience
@@ -172,7 +174,13 @@ def build_parser() -> argparse.ArgumentParser:
              "gathers — multi-GB at billion-param scale and a non-starter "
              "multi-host).  Checkpoint paths become directories; --dalle_path "
              "accepts them for resume.")
-    parser.add_argument("--batch_size", type=int, default=4)
+    # None = unset (resolved to 4 in main; --dummy_run defaults to
+    # 2x device count) so an EXPLICIT --batch_size survives the dummy-run
+    # defaults — the elastic shrink/grow drills pin it so the data stream
+    # is identical across different device counts
+    parser.add_argument("--batch_size", type=int, default=None,
+                        help="global batch size (default 4; --dummy_run "
+                             "defaults to 2x device count unless set)")
     parser.add_argument("--ga_steps", type=int, default=1, help="gradient accumulation steps")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--clip_grad_norm", type=float, default=0.5)
@@ -351,12 +359,16 @@ def reconstitute_vae(args, resume=None):
 
 def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
                         global_step=0, wandb_run_id=None, health_state=None,
-                        data_state=None, fleet_state=None, memory_state=None):
+                        data_state=None, fleet_state=None, memory_state=None,
+                        topology=None):
     """(trees, meta) for a checkpoint — the device->host gather happens HERE
     (np.asarray inside to_host), so the result is a consistent snapshot that
     can be serialized later on the async writer thread.  `data_state`
     (resilience.data_state_dict) is what makes resume exact: epoch,
-    within-epoch batch cursor, shuffle seed, RNG key."""
+    within-epoch batch cursor, shuffle seed, RNG key.  `topology`
+    (parallel/registry.topology_meta) records the mesh shape + partitioning
+    registry this state was sharded under — what lets a resume on a changed
+    topology reshard instead of failing."""
     class_name, vae_meta = vae_registry.config_to_meta(vae_cfg)
     trees = {
         "weights": to_host(state.params),
@@ -376,6 +388,7 @@ def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
         "data_state": data_state,
         "fleet_state": fleet_state,
         "memory_state": memory_state,
+        "topology": topology,
     }
     return trees, meta
 
@@ -383,7 +396,7 @@ def build_model_payload(state, dalle_cfg, vae_params, vae_cfg, epoch,
 def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
                global_step=0, wandb_run_id=None, health_state=None,
                data_state=None, fleet_state=None, memory_state=None,
-               writer=None):
+               topology=None, writer=None):
     """Gather + write one npz checkpoint.  With `writer` (an
     AsyncCheckpointWriter), only the gather runs here — serialization,
     fsync, atomic rename, and rotation happen on the writer thread and this
@@ -392,7 +405,7 @@ def save_model(path, state, dalle_cfg, vae_params, vae_cfg, epoch, keep_n=None,
         state, dalle_cfg, vae_params, vae_cfg, epoch, global_step=global_step,
         wandb_run_id=wandb_run_id, health_state=health_state,
         data_state=data_state, fleet_state=fleet_state,
-        memory_state=memory_state,
+        memory_state=memory_state, topology=topology,
     )
     glob_pat = _rotation_glob(path) if keep_n is not None else None
     if writer is not None:
@@ -418,7 +431,7 @@ def _rotation_glob(path) -> str:
 def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
                        keep_n=None, global_step=0, wandb_run_id=None,
                        health_state=None, data_state=None, fleet_state=None,
-                       memory_state=None):
+                       memory_state=None, topology=None):
     """Distributed save: the TrainState goes through orbax, each host writing
     only the shards it owns — ZeRO-3/pp-sharded params and optimizer state are
     never gathered (`save_model`'s np.asarray would pull the full arrays to
@@ -439,21 +452,42 @@ def save_model_sharded(path, state, dalle_cfg, vae_params, vae_cfg, epoch,
         "data_state": data_state,
         "fleet_state": fleet_state,
         "memory_state": memory_state,
+        "topology": topology,
     }
     path = Path(path)
-    save_sharded(
-        str(path),
-        {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
-        meta,
-    )
     if jax.process_index() == 0:
+        # the VAE sidecar lands FIRST: save_sharded writes meta.json last,
+        # making it the directory's commit marker — a save torn by
+        # preemption can never present meta.json with vae.npz missing
+        # (validate_checkpoint additionally screens for the sidecar the
+        # meta declares, so --resume auto falls back past torn directories)
+        path.mkdir(parents=True, exist_ok=True)
         save_checkpoint(
             str(path / "vae.npz"),
             trees={"vae_weights": to_host(vae_params)},
             meta={"vae_params": vae_meta, "vae_class_name": class_name},
         )
-        if keep_n is not None:
-            rotate_checkpoints(str(path.parent), _rotation_glob(path), keep_n)
+    save_sharded(
+        str(path),
+        {"step": state.step, "weights": state.params, "opt_state": state.opt_state},
+        meta,
+    )
+    if jax.process_index() == 0 and keep_n is not None:
+        rotate_checkpoints(str(path.parent), _rotation_glob(path), keep_n)
+
+
+def _announce_reshard(rr):
+    """Root-process log of a ReshardRequired detection — shared by the
+    auto-discovery and explicit-path resume branches so the loud
+    rules-changed warning cannot be dropped from one of them."""
+    print(f"[resilience] {rr}")
+    if rr.rules_changed:
+        print("[resilience] WARNING: the partitioning REGISTRY changed "
+              "since this checkpoint was saved — restoring under the "
+              "current rules (review parallel/registry.py changes if "
+              "placement parity matters)")
+    print("[resilience] elastic resume: resharding onto the live mesh "
+          "(memory preflight below)")
 
 
 def _apply_dummy_run_defaults(args):
@@ -463,10 +497,13 @@ def _apply_dummy_run_defaults(args):
     args.dim, args.depth, args.heads, args.dim_head = 64, 2, 2, 16
     args.text_seq_len, args.num_text_tokens = 16, 256
     # 2x device count: the deliberately ragged final batch (half size) must
-    # still shard over the default dp mesh axis
+    # still shard over the default dp mesh axis.  An EXPLICIT --batch_size
+    # wins — the elastic shrink/grow drills resume on a different device
+    # count and need the same batch stream on both sides
     import jax as _jax
 
-    args.batch_size = 2 * _jax.device_count()
+    if args.batch_size is None:
+        args.batch_size = 2 * _jax.device_count()
     args.epochs = 1
     args.num_workers = min(args.num_workers, 2)
     # respect EXPLICIT cadences (the crash-and-resume tests run dummy mode
@@ -493,6 +530,8 @@ def main(argv=None):
         args.save_every_n_steps = 1000
     if args.sample_every_n_steps is None:
         args.sample_every_n_steps = 100
+    if args.batch_size is None:
+        args.batch_size = 4
 
     be = backend_mod.set_backend_from_args(args)
     be.initialize()
@@ -500,31 +539,55 @@ def main(argv=None):
 
     out_file = f"{args.dalle_output_file_name}.pt"
 
+    # the partitioning registry: the ONE rule table that places params and
+    # optimizer state, stamps checkpoint topology, and prices the ledgers
+    registry = registry_mod.default_registry()
+    # the mesh this run will distribute over — built ONCE, so the stamped
+    # checkpoint topology, the memory ledger, and the actual distribution
+    # below all derive from the same resolution
+    mesh_cfg = MeshConfig(
+        args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp, args.mesh_pp
+    )
+    # this run's topology identity (mesh shape + device count + registry
+    # fingerprint) — stamped into every checkpoint and compared against the
+    # one a resumed checkpoint was saved under
+    try:
+        live_axes = _dc.asdict(mesh_cfg.resolve(jax.device_count()))
+    except Exception:
+        live_axes = {}
+    live_topology = registry_mod.topology_meta(
+        live_axes, registry, device_count=jax.device_count())
+
     # --resume: 'auto' discovers the newest VALID checkpoint next to the
-    # output file (falling back past truncated/corrupt ones), a path resumes
-    # from that file.  Either way it feeds the existing --dalle_path plumbing.
+    # output file (falling back past truncated/corrupt ones; orbax sharded
+    # checkpoint DIRECTORIES are discovered too), a path resumes from that
+    # file.  Either way it feeds the existing --dalle_path plumbing.  A
+    # checkpoint saved under a DIFFERENT topology (a preemption gave back
+    # fewer chips, a dp8 file restored for tp4xdp2 serving) no longer fails:
+    # the restore reshards onto the live mesh through the registry, gated by
+    # the memory-ledger preflight below.
+    reshard_note = None
     if args.resume is not None:
         if args.dalle_path is not None:
             raise SystemExit("--resume and --dalle_path are mutually exclusive")
         if args.resume == "auto":
-            if args.sharded_checkpoint:
-                # orbax checkpoints are directories; discovery/validation
-                # covers the npz format only — fail loudly rather than
-                # silently fresh-starting over existing progress
-                raise SystemExit(
-                    "--resume auto supports npz checkpoints only; resume a "
-                    "--sharded_checkpoint run with --dalle_path <checkpoint dir>"
-                )
             if be.get_world_size() > 1 and is_root:
                 # every process globs its own disk; without a shared
                 # filesystem the workers would silently fresh-start
                 print("[resilience] --resume auto on a multi-process run "
                       "assumes the output dir is on a SHARED filesystem "
                       "(all processes must discover the same checkpoint)")
-            found, _found_meta = resilience.find_latest_valid_checkpoint(
+            found, found_meta = resilience.find_latest_valid_checkpoint(
                 out_file, log=print if is_root else None
             )
             if found is not None:
+                try:
+                    resilience.check_topology(found_meta, live_topology,
+                                              path=found)
+                except resilience.ReshardRequired as rr:
+                    reshard_note = rr
+                    if is_root:
+                        _announce_reshard(rr)
                 args.dalle_path = found
                 if is_root:
                     print(f"[resilience] --resume auto: resuming from {found}")
@@ -590,6 +653,19 @@ def main(argv=None):
             else None
         )
 
+    # explicit-path resumes (--dalle_path / --resume PATH) get the same
+    # topology check the auto discovery ran: a changed mesh shape or device
+    # count reshards (preflighted below) instead of surfacing as a cryptic
+    # placement failure
+    if resume is not None and reshard_note is None:
+        try:
+            resilience.check_topology(resume[1], live_topology,
+                                      path=str(args.dalle_path))
+        except resilience.ReshardRequired as rr:
+            reshard_note = rr
+            if is_root:
+                _announce_reshard(rr)
+
     if args.dummy_run is not None:
         # tiny randomly-initialized image tokenizer: the smoke path must not
         # depend on a trained VAE checkpoint or a pretrained download
@@ -651,8 +727,6 @@ def main(argv=None):
 
     # pipeline engagement follows THIS run's mesh, not the checkpoint's: a
     # resume with --mesh_pp must activate the pipeline (and vice versa)
-    import dataclasses as _dc
-
     dalle_cfg = _dc.replace(
         dalle_cfg,
         pipeline_axis="pp" if args.mesh_pp > 1 else None,
@@ -788,22 +862,35 @@ def main(argv=None):
             else float(args.loss_scale)
         ) if args.loss_scale is not None else ("dynamic" if args.fp16 else None),
     )
-    mesh_cfg = MeshConfig(
-        args.mesh_dp, args.mesh_fsdp, args.mesh_tp, args.mesh_sp, args.mesh_pp
-    )
-
     # --- memory observability (observability/memory.py) --------------------
     # The ledger is priced BEFORE distribution (placement itself can OOM) from
     # the resolved mesh shape + start params (optimizer moments estimated),
     # and refreshed from the live trees at the crosscheck site below.
-    try:
-        mem_axes = _dc.asdict(mesh_cfg.resolve(jax.device_count()))
-    except Exception:
-        mem_axes = {}
+    # `live_axes` is the same resolution the checkpoint topology was stamped
+    # from (mesh_cfg, built once at the top of main).
+    mem_axes = live_axes
     mem_ledger = memory_mod.dalle_step_memory(
         mem_axes, start_params, None, dalle_cfg, args.batch_size,
-        settings=settings,
+        settings=settings, registry=registry,
     )
+
+    # elastic-resume preflight: the checkpoint is moving to a DIFFERENT
+    # topology — refuse BEFORE distribution touches a device when the
+    # target's analytic ledger says it cannot fit (a dp8 state only fit
+    # because it was 8-way sharded; shrinking to dp2 must fail with a
+    # ledger, not a RESOURCE_EXHAUSTED after minutes of compilation)
+    if reshard_note is not None and mem_ledger.get("fits") is False:
+        if is_root:
+            print("[resilience] reshard REFUSED: the target topology "
+                  f"{mem_ledger.get('mesh')} needs "
+                  f"{mem_ledger['total_bytes'] / 1e9:.2f}GB per chip "
+                  f"(dominant: {mem_ledger['dominant']}) but capacity is "
+                  f"{mem_ledger['capacity_bytes'] / 1e9:.2f}GB — use more "
+                  "chips, a higher --zero_stage, --execution remat, or "
+                  "bf16 param storage.  Exiting with code "
+                  f"{resilience.EXIT_OOM} (do not auto-restart this "
+                  "config)", flush=True)
+        raise SystemExit(resilience.EXIT_OOM)
 
     def oom_bail(e, phase, step=None):
         """RESOURCE_EXHAUSTED forensics: write oom_report_*.txt (ledger
@@ -838,7 +925,7 @@ def main(argv=None):
     try:
         state, step_fn, _, _ = be.distribute(
             loss_fn=loss_fn, params=start_params, optimizer=optimizer,
-            mesh_config=mesh_cfg, settings=settings,
+            mesh_config=mesh_cfg, settings=settings, registry=registry,
         )
     except Exception as e:
         if memory_mod.is_oom_error(e):
@@ -869,7 +956,7 @@ def main(argv=None):
             )
             state, step_fn, _, _ = be.distribute(
                 loss_fn=loss_fn, params=migrated, optimizer=optimizer,
-                mesh_config=mesh_cfg, settings=settings,
+                mesh_config=mesh_cfg, settings=settings, registry=registry,
             )
             state = TrainState(jnp.asarray(restored["step"]), state.params, state.opt_state)
     elif resume_meta is not None and "opt_state" in trees:
@@ -886,9 +973,20 @@ def main(argv=None):
                   "optimizer (weights restored + migrated)")
             saved_opt = None
         if saved_opt is not None:
+            # each restored moment lands directly on the FRESH leaf's
+            # sharding (the registry placement init_fn just computed for the
+            # live mesh) — jnp.asarray would commit the full host array to
+            # one default device, discarding the placement and materializing
+            # unsharded moments exactly where the elastic preflight said
+            # only sharded ones fit
+            def _restore_opt_leaf(cur, saved):
+                if not hasattr(cur, "dtype"):
+                    return saved
+                host = np.asarray(saved).astype(cur.dtype)
+                return jax.device_put(host, getattr(cur, "sharding", None))
+
             state = TrainState(state.step, state.params, jax.tree_util.tree_map(
-                lambda cur, saved: jnp.asarray(saved).astype(cur.dtype) if hasattr(cur, "dtype") else saved,
-                state.opt_state, saved_opt,
+                _restore_opt_leaf, state.opt_state, saved_opt,
             ))
 
     logger = MetricLogger(
@@ -1051,7 +1149,7 @@ def main(argv=None):
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
                     data_state=ds, fleet_state=fleet_state,
-                    memory_state=memory_state)
+                    memory_state=memory_state, topology=live_topology)
             else:
                 save_model(
                     path, state, dalle_cfg, vae_params, vae_cfg, epoch,
@@ -1059,7 +1157,8 @@ def main(argv=None):
                     global_step=global_step if step is None else step,
                     wandb_run_id=logger.run_id, health_state=health_state,
                     data_state=ds, fleet_state=fleet_state,
-                    memory_state=memory_state, writer=writer)
+                    memory_state=memory_state, topology=live_topology,
+                    writer=writer)
         obs_metrics.histogram("checkpoint_save_s").observe(time.perf_counter() - t0)
         if writer is None:
             # the async writer counts completions itself (checkpoints_saved)
@@ -1217,6 +1316,7 @@ def main(argv=None):
                                 getattr(step_fn, "mesh", None), state.params,
                                 dalle_cfg, int(device_batch["text"].shape[0]),
                                 settings=settings,
+                                registry=getattr(step_fn, "registry", registry),
                             )
                             ledger_bytes = None
                             if ledger is not None and args.fleet:
@@ -1258,6 +1358,7 @@ def main(argv=None):
                                 state.params, state.opt_state, dalle_cfg,
                                 int(device_batch["text"].shape[0]),
                                 settings=settings,
+                                registry=getattr(step_fn, "registry", registry),
                             )
                             memory_mod.publish_gauges(
                                 mem_ledger, obs_metrics.REGISTRY)

@@ -117,14 +117,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def save_model(path: str, params, cfg: DiscreteVAEConfig, health_state=None,
-               fleet_state=None, memory_state=None, writer=None):
+               fleet_state=None, memory_state=None, topology=None,
+               writer=None):
     """Gather + write the VAE checkpoint.  With `writer` (an
     AsyncCheckpointWriter) only the host gather runs here; serialization +
-    fsync + rename happen on the writer thread."""
+    fsync + rename happen on the writer thread.  `topology`
+    (parallel/registry.topology_meta) records the device count + registry
+    fingerprint the run trained under — the VAE step is replicated (no
+    mesh), so a changed topology restores transparently, but the record
+    keeps the check uniform across both CLIs."""
     trees = {"weights": to_host(params)}
     meta = {"hparams": cfg.to_dict(), "version": __version__,
             "health_state": health_state, "fleet_state": fleet_state,
-            "memory_state": memory_state}
+            "memory_state": memory_state, "topology": topology}
     if writer is not None:
         writer.submit(path, trees, meta)
         return
@@ -158,6 +163,15 @@ def main(argv=None):
     # quietly starts fresh when nothing resumable exists; a bad file fails
     # with validate_checkpoint's distinct error.  Optimizer state starts
     # fresh — the VAE checkpoint stores weights only.
+    # topology identity (device count + partitioning-registry fingerprint):
+    # stamped into every checkpoint; the VAE state is replicated so a
+    # changed device count restores transparently — the check below is
+    # informational parity with train_dalle's elastic resume
+    from dalle_pytorch_tpu.parallel import registry as registry_mod
+
+    live_topology = registry_mod.topology_meta(
+        {}, device_count=jax.device_count())
+
     resume_params = None
     resume_meta = None
     if args.resume is not None:
@@ -165,6 +179,13 @@ def main(argv=None):
                  else args.resume)
         try:
             meta = resilience.validate_checkpoint(rpath)
+            try:
+                resilience.check_topology(meta, live_topology, path=rpath)
+            except resilience.ReshardRequired as rr:
+                if is_root:
+                    print(f"[resilience] {rr}")
+                    print("[resilience] VAE weights are replicated — "
+                          "restoring onto the live devices")
         except resilience.CheckpointInvalidError as e:
             if args.resume != "auto":
                 raise
@@ -342,7 +363,7 @@ def main(argv=None):
 
     # fail fast on unwritable output before burning compute (flushed through
     # the async writer so the failure still lands before compilation)
-    save_model(out_file, params, cfg, writer=writer)
+    save_model(out_file, params, cfg, topology=live_topology, writer=writer)
     if writer is not None:
         writer.flush()
 
@@ -353,7 +374,8 @@ def main(argv=None):
         if is_root:
             save_model(out_file, params, cfg, health_state=_health_state(),
                        fleet_state=_fleet_state(),
-                       memory_state=_memory_state(), writer=writer)
+                       memory_state=_memory_state(),
+                       topology=live_topology, writer=writer)
         if writer is not None:
             writer.flush()
         if is_root:
@@ -468,7 +490,8 @@ def main(argv=None):
                         save_model(out_file, params, cfg,
                                    health_state=_health_state(),
                                    fleet_state=_fleet_state(),
-                                   memory_state=_memory_state(), writer=writer)
+                                   memory_state=_memory_state(),
+                                   topology=live_topology, writer=writer)
                     obs_metrics.histogram("checkpoint_save_s").observe(
                         time.perf_counter() - t_save
                     )
@@ -493,7 +516,8 @@ def main(argv=None):
                 save_model(out_file, params, cfg,
                            health_state=_health_state(),
                            fleet_state=_fleet_state(),
-                           memory_state=_memory_state(), writer=writer)
+                           memory_state=_memory_state(),
+                           topology=live_topology, writer=writer)
                 logger.log({"epoch_time_s": time.time() - t0, "epoch": epoch}, step=global_step)
     except Exception as e:
         # RESOURCE_EXHAUSTED at compile or step time: forensic report +

@@ -121,13 +121,20 @@ def step_comms_ledger(
     grad_accum: int = 1,
     pp_num_micro: Optional[int] = None,
     pp_interleave: int = 1,
+    param_shard_fraction: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Per-chip wire bytes per optimizer step for each active mesh axis.
 
     `axes` is {axis: size} (see parallel/mesh.axis_sizes — a plain dict works
     too, so hypothetical meshes can be priced without devices).  `batch` is
     the GLOBAL per-step batch; activations are sharded over (dp, fsdp), so
-    activation collectives are priced at the local batch."""
+    activation collectives are priced at the local batch.
+
+    `param_shard_fraction` overrides the 1/(tp·pp) every-leaf-shards
+    approximation with the EXACT at-rest fraction from the partitioning
+    registry (dalle_step_comms computes it when handed the registry) — the
+    dp/fsdp collectives move each chip's OWN shard, so their payloads are
+    priced at that fraction."""
     d = int(axes.get("dp", 1))
     f = int(axes.get("fsdp", 1))
     t = int(axes.get("tp", 1))
@@ -137,12 +144,15 @@ def step_comms_ledger(
     data_shards = max(d * f, 1)
     batch_local = max(batch // data_shards, 1)
     # params (and so gradients) are sharded over tp at rest (Megatron
-    # column/row specs) and over pp (sharding.py folds pp into the
+    # column/row specs) and over pp (the registry folds pp into the
     # data-sharding axes), so the dp/fsdp collectives each chip runs move
-    # only its OWN shard of the tree.  Approximation: every leaf is treated
-    # as tp/pp-shardable — matmul weights (the tree's mass) are; the small
-    # non-TP-ruled leaves (norms, biases without a rule) are over-divided.
-    param_shard = 1.0 / max(t * p, 1)
+    # only its OWN shard of the tree.  Default approximation: every leaf is
+    # treated as tp/pp-shardable — matmul weights (the tree's mass) are; the
+    # small non-TP-ruled leaves (norms, biases without a rule) are
+    # over-divided.  With param_shard_fraction the exact registry figure
+    # replaces it.
+    param_shard = (param_shard_fraction if param_shard_fraction is not None
+                   else 1.0 / max(t * p, 1))
     grad_local = grad_bytes * param_shard
     param_local = param_bytes * param_shard
     per_axis: List[Dict[str, Any]] = []
@@ -236,17 +246,28 @@ def step_comms_ledger(
 
 def dalle_step_comms(mesh: Union[Mapping[str, int], Any, None], params: Any,
                      cfg: Any, batch: int,
-                     settings: Any = None) -> Optional[Dict[str, Any]]:
+                     settings: Any = None,
+                     registry: Any = None) -> Optional[Dict[str, Any]]:
     """The ledger for a live DALLE training step: sizes from the mesh (a
     `jax.sharding.Mesh` or a plain {axis: size} mapping), payload bytes from
     the param tree, dtypes and ZeRO stage from the StepSettings, geometry
     from the DALLEConfig.  Returns None without a mesh (single-chip: no
-    inter-chip traffic to account)."""
+    inter-chip traffic to account).
+
+    `registry` (parallel/registry.PartitionRegistry — pass the step_fn's)
+    prices the at-rest param/grad shard each dp/fsdp collective moves at
+    its EXACT per-leaf fraction instead of the 1/(tp·pp) approximation —
+    the same rules the cross-check audits."""
     if mesh is None:
         return None
     from dalle_pytorch_tpu.parallel.mesh import axis_sizes
 
     axes = axis_sizes(mesh)
+    shard_fraction = None
+    if registry is not None:
+        # zero_stage 0 here deliberately: this fraction is the tp/pp at-rest
+        # division only — the fsdp sharding is what the fsdp ROW prices
+        shard_fraction = registry.shard_fraction(params, axes, 0)
     param_bytes = tree_float_bytes(params)
     if settings is not None and getattr(settings, "grad_dtype", None) is not None:
         grad_bytes = tree_float_bytes(params, itemsize=_itemsize(settings.grad_dtype))
@@ -270,6 +291,7 @@ def dalle_step_comms(mesh: Union[Mapping[str, int], Any, None], params: Any,
         grad_accum=int(getattr(settings, "grad_accum", 1) or 1) if settings is not None else 1,
         pp_num_micro=getattr(cfg, "pp_num_micro", None),
         pp_interleave=int(getattr(cfg, "pp_interleave", 1) or 1),
+        param_shard_fraction=shard_fraction,
     )
 
 

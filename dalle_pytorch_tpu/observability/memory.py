@@ -94,7 +94,13 @@ def rest_shard_fraction(axes: Mapping[str, int], zero_stage: int = 0,
                         moments: bool = False) -> float:
     """Fraction of a param-shaped tree each chip holds AT REST — the comms
     ledger's shard-pricing rules (params are tp/pp-sharded at rest;
-    fsdp-sharded under ZeRO-3, moments already under ZeRO-1)."""
+    fsdp-sharded under ZeRO-3, moments already under ZeRO-1).
+
+    This is the every-leaf-shards APPROXIMATION for pricing hypothetical
+    meshes without a tree in hand.  When the live trees exist, the ledgers
+    price the EXACT fraction from the partitioning registry instead
+    (`PartitionRegistry.shard_fraction` — the same rule table that placed
+    the state), so ledger and reality cannot drift apart silently."""
     t = int(axes.get("tp", 1))
     p = int(axes.get("pp", 1))
     f = int(axes.get("fsdp", 1))
@@ -221,20 +227,27 @@ def step_memory_ledger(
     pp_num_micro: Optional[int] = None,
     input_bytes: float = 0.0,
     capacity_bytes: Optional[float] = None,
+    param_shard_fraction: Optional[float] = None,
+    moment_shard_fraction: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Per-chip resident HBM of one optimizer step, row by row.
 
     `axes` is {axis: size} (a plain dict works — hypothetical meshes are
     priced without devices; {} is a single chip).  `param_bytes` /
     `grad_bytes` / `opt_bytes` are WHOLE-tree bytes in their storage dtypes;
-    the rows apply the at-rest shard fractions.  `accum_bytes` is the f32
-    microbatch accumulator (defaults to grad_bytes repriced at 4 bytes is
-    the caller's job — pass it explicitly); `input_bytes` is the on-device
-    batch (text ids + pixels, including prefetch depth)."""
+    the rows apply the at-rest shard fractions — the scalar
+    `rest_shard_fraction` model by default, or the EXACT registry-priced
+    `param_shard_fraction` / `moment_shard_fraction` when the caller has
+    the live trees (dalle_step_memory passes them).  `accum_bytes` is the
+    f32 microbatch accumulator (defaults to grad_bytes repriced at 4 bytes
+    is the caller's job — pass it explicitly); `input_bytes` is the
+    on-device batch (text ids + pixels, including prefetch depth)."""
     # host-sync-ok: mesh-axis sizes are static python ints
     axes = {k: int(v) for k, v in dict(axes).items()}
-    p_frac = rest_shard_fraction(axes, zero_stage, moments=False)
-    m_frac = rest_shard_fraction(axes, zero_stage, moments=True)
+    p_frac = (param_shard_fraction if param_shard_fraction is not None
+              else rest_shard_fraction(axes, zero_stage, moments=False))
+    m_frac = (moment_shard_fraction if moment_shard_fraction is not None
+              else rest_shard_fraction(axes, zero_stage, moments=True))
 
     rows: List[Dict[str, Any]] = [
         {"name": "params", "bytes": param_bytes * p_frac,
@@ -303,12 +316,18 @@ def dalle_step_memory(
     settings: Any = None,
     input_bytes: float = 0.0,
     capacity_bytes: Optional[float] = None,
+    registry: Any = None,
 ) -> Dict[str, Any]:
     """The HBM ledger for a live DALLE training step: payload bytes from the
     actual param/optimizer trees (their storage dtypes — a bf16-stored run
     prices at 2 bytes), dtypes and ZeRO stage from the StepSettings, geometry
     and execution policy from the DALLEConfig.  Unlike the comms ledger, a
-    missing mesh is NOT a no-op — single-chip runs OOM too ({} = one chip)."""
+    missing mesh is NOT a no-op — single-chip runs OOM too ({} = one chip).
+
+    `registry` (parallel/registry.PartitionRegistry — pass the step_fn's)
+    replaces the scalar at-rest shard fractions with the EXACT per-leaf
+    fractions the placement rules produce, so the ledger is priced from the
+    same table that sharded the state it audits."""
     if mesh is None:
         axes: Mapping[str, int] = {}
     else:
@@ -335,6 +354,16 @@ def dalle_step_memory(
         compute_itemsize = _itemsize(settings.compute_dtype)
     grad_accum = int(getattr(settings, "grad_accum", 1) or 1) if settings is not None else 1
 
+    zero_stage = int(getattr(settings, "zero_stage", 0) or 0) if settings is not None else 0
+    p_frac = m_frac = None
+    if registry is not None:
+        p_frac = registry.shard_fraction(params, axes, zero_stage)
+        # moments mirror the param tree's paths when no live opt tree exists
+        m_frac = registry.shard_fraction(
+            opt_state if opt_state is not None else params, axes,
+            zero_stage, moments=True,
+            itemsize=None if opt_state is not None else 4,
+        )
     execution = getattr(cfg, "resolved_execution", None) or "sequential"
     flash = _resolves_to_flash(getattr(cfg, "attn_kernel", "auto"))
     return step_memory_ledger(
@@ -349,7 +378,7 @@ def dalle_step_memory(
         heads=cfg.heads,
         dim_head=cfg.dim_head,
         compute_itemsize=compute_itemsize,
-        zero_stage=int(getattr(settings, "zero_stage", 0) or 0) if settings is not None else 0,
+        zero_stage=zero_stage,
         grad_accum=grad_accum,
         accum_bytes=tree_float_bytes(params, itemsize=4) if grad_accum > 1 else None,
         execution=execution,
@@ -358,6 +387,8 @@ def dalle_step_memory(
         pp_num_micro=getattr(cfg, "pp_num_micro", None),
         input_bytes=input_bytes,
         capacity_bytes=capacity_bytes,
+        param_shard_fraction=p_frac,
+        moment_shard_fraction=m_frac,
     )
 
 

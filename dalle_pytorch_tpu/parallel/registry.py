@@ -1,0 +1,411 @@
+"""Declarative partitioning registry: ONE ordered rule table mapping
+parameter-path regexes to PartitionSpecs.
+
+This is the single source of truth for where every parameter (and optimizer
+moment) lives at rest and inside the step.  It replaces the imperative
+per-leaf logic that used to live in `parallel/sharding.py` (which now
+delegates here), and it is consumed by:
+
+  * `parallel/train_step.make_train_step` — the init-time placement of the
+    TrainState (params + optimizer state) on the mesh;
+  * checkpoint save/restore — `topology_meta` records the mesh shape and
+    the registry FINGERPRINT in checkpoint meta, so a resume can tell
+    "same placement rules, different topology" (reshard) from "different
+    rules entirely" (refuse loudly);
+  * the analytic ledgers — `observability/comms.py` and
+    `observability/memory.py` re-price their at-rest shard fractions from
+    `PartitionRegistry.shard_fraction` (the same rules the cross-checks
+    audit), so the ledger and the actual placement cannot drift apart
+    silently;
+  * `parallel/reshard.py` — moving a live TrainState (or a restored
+    checkpoint) between mesh topologies re-resolves every leaf against the
+    TARGET mesh through the same table.
+
+The pattern is dalle-mini's regex partitioning rules (SNIPPETS.md [1]) and
+torch_xla2's `sharding_map` (SNIPPETS.md [3]), adapted to this repo's
+path layout and made shape-aware: a rule's spec template only applies when
+its length matches the leaf's rank (a stacked scan-layers weight is 3-d and
+falls through the 2-d Megatron rules to the data-sharding default, exactly
+as the imperative code behaved), and data-axis slots degrade gracefully to
+replication when a dim is not divisible by the axis size.
+
+Spec-template entries:
+
+  "tp"    the tensor-parallel mesh axis (Megatron column/row placement)
+  "data"  the at-rest data-sharding slot: the largest prefix of the active
+          data axes (fsdp when ZeRO says params shard, plus pp whenever the
+          mesh has pipeline stages) whose product divides this dim
+  None    this dim is replicated
+
+A rule whose `spec` is the LARGEST sentinel shards the largest divisible
+dim of the leaf over the data axes (the default for everything without a
+TP rule — embedding tables, stacked scan weights, norms large enough to
+bother).
+
+Everything here is host-side path/shape arithmetic — no device value is
+ever touched (tools/lint_host_sync.py covers this module)."""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import re
+from typing import Any, Mapping, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, PartitionSpec
+
+from dalle_pytorch_tpu.parallel.mesh import (
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_TP,
+    axis_sizes,
+)
+
+P = PartitionSpec
+
+# data-slot marker inside a spec template (resolved per-leaf against the
+# active data axes), and the shard-largest-dim default sentinel
+DATA = "data"
+LARGEST = "largest"
+
+# bump when the RESOLUTION SEMANTICS change (not just the rule list): the
+# fingerprint hashes this together with the rules, so a checkpoint written
+# under different semantics is flagged even if the rule text matches
+_SEMANTICS_VERSION = 1
+
+# leaves smaller than this stay replicated under the default rule —
+# sharding a tiny norm vector buys nothing and costs collective latency
+MIN_SHARD_SIZE = 2 ** 14
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One ordered entry of the table: `pattern` is re.search'd against the
+    '/'-joined parameter path; `spec` is a per-dim template (its length must
+    equal the leaf's rank for the rule to apply) or the LARGEST sentinel.
+    `tp_only` rules are skipped entirely when tensor parallelism is off."""
+
+    pattern: str
+    spec: Union[Tuple[Optional[str], ...], str]
+    tp_only: bool = False
+    note: str = ""
+
+    def __post_init__(self):
+        # precompiled matcher; object.__setattr__ because frozen
+        object.__setattr__(self, "_rx", re.compile(self.pattern))
+
+    def matches(self, path: str, ndim: int, tensor_parallel: bool) -> bool:
+        if self.tp_only and not tensor_parallel:
+            return False
+        if self.spec != LARGEST and len(self.spec) != ndim:
+            return False
+        return self._rx.search(path) is not None
+
+
+# The default table, reproducing the repo's established placement exactly
+# (tests/test_resharding.py proves leaf-for-leaf parity with the imperative
+# rules this replaced):
+#   column-parallel: qkv / ff-up (w1, w1g) project dim -> wider; shard the
+#     OUTPUT dim over tp, the input dim over the data slot
+#   row-parallel: attention out-proj / ff-down (w2) come back to the
+#     residual stream; shard the INPUT dim over tp so XLA emits exactly one
+#     all-reduce per residual branch (the Megatron pattern)
+#   vocab-sharded logits projection + the matching bias rules
+#   everything else: largest divisible dim over the data axes
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule(r"qkv/w|w1/w|w1g/w", (DATA, AXIS_TP), tp_only=True,
+         note="column parallel (qkv / ff-up projections)"),
+    Rule(r"(?=.*shared_attn)(?=.*out/w)|w2/w", (AXIS_TP, DATA), tp_only=True,
+         note="row parallel (attention out / ff-down projections)"),
+    Rule(r"logits_linear/w", (DATA, AXIS_TP), tp_only=True,
+         note="vocab-sharded output projection"),
+    Rule(r"w1/b|w1g/b|logits_linear/b", (AXIS_TP,), tp_only=True,
+         note="biases of column/vocab-parallel projections"),
+    Rule(r".*", LARGEST,
+         note="default: largest divisible dim over the data axes"),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _norm_axes(mesh_or_axes: Union[Mesh, Mapping[str, int], None]) -> dict:
+    if mesh_or_axes is None:
+        return {}
+    # host-sync-ok: mesh-axis sizes are static python ints
+    return {k: int(v) for k, v in axis_sizes(mesh_or_axes).items()}
+
+
+def _axes_prod(axes: Mapping[str, int], names: Sequence[str]) -> int:
+    return math.prod(axes.get(a, 1) for a in names)
+
+
+def _data_axes(axes: Mapping[str, int], include_fsdp: bool) -> Tuple[str, ...]:
+    """Mesh axes params/moments shard over at rest: fsdp (when ZeRO says so)
+    plus pp whenever the mesh actually has pipeline stages."""
+    out = []
+    if include_fsdp and axes.get(AXIS_FSDP, 1) > 1:
+        out.append(AXIS_FSDP)
+    if axes.get(AXIS_PP, 1) > 1:
+        out.append(AXIS_PP)
+    return tuple(out)
+
+
+def _data_slot(dim_size: int, data_axes: Tuple[str, ...],
+               axes: Mapping[str, int]):
+    """The data-axes entry for one dim of a TP-ruled leaf: the largest
+    prefix of `data_axes` that divides the dim (fsdp first, then fsdp+pp),
+    or None."""
+    best = None
+    for end in range(1, len(data_axes) + 1):
+        cand = data_axes[:end]
+        if dim_size % _axes_prod(axes, cand) == 0:
+            best = cand
+    if best is None:
+        return None
+    return best if len(best) > 1 else best[0]
+
+
+def _shard_largest(shape: Tuple[int, ...], data_axes: Tuple[str, ...],
+                   axes: Mapping[str, int],
+                   min_size: int = MIN_SHARD_SIZE) -> PartitionSpec:
+    """Spec sharding the largest divisible dim of a leaf over `data_axes`
+    (tried as the full tuple first, then each axis alone, so an odd dim
+    still gets whatever sharding fits)."""
+    size = math.prod(shape) if shape else 0
+    if not data_axes or not shape or size < min_size:
+        return P()
+    candidates = ([data_axes] if len(data_axes) == 1
+                  else [data_axes, *[(a,) for a in data_axes]])
+    dims = list(shape)
+    order = sorted(range(len(dims)), key=lambda i: -dims[i])
+    for cand in candidates:
+        n = _axes_prod(axes, cand)
+        for i in order:
+            if dims[i] % n == 0 and dims[i] >= n:
+                spec = [None] * len(dims)
+                spec[i] = cand if len(cand) > 1 else cand[0]
+                return P(*spec)
+    return P()
+
+
+def _spec_divisor(spec: PartitionSpec, axes: Mapping[str, int]) -> int:
+    """How many ways `spec` splits a leaf on a mesh of `axes` sizes."""
+    div = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        div *= _axes_prod(axes, names)
+    return div
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRegistry:
+    """The ordered rule table plus its resolution semantics.  First matching
+    rule wins; a leaf no rule claims is replicated."""
+
+    rules: Tuple[Rule, ...] = DEFAULT_RULES
+    min_shard_size: int = MIN_SHARD_SIZE
+
+    # -- per-leaf resolution ------------------------------------------------
+
+    def resolve(
+        self,
+        path: str,
+        shape: Tuple[int, ...],
+        mesh_or_axes: Union[Mesh, Mapping[str, int], None],
+        *,
+        zero_stage: int = 0,
+        tensor_parallel: Optional[bool] = None,
+        moments: bool = False,
+    ) -> PartitionSpec:
+        """PartitionSpec for one leaf.  `moments=True` applies the optimizer
+        -state extra: a leaf the param rules left replicated is still
+        sharded over fsdp under ZeRO-1/2 (each chip owns its moment shard
+        even though params are replicated)."""
+        axes = _norm_axes(mesh_or_axes)
+        if tensor_parallel is None:
+            tensor_parallel = axes.get(AXIS_TP, 1) > 1
+        params_sharded = zero_stage >= 3 and axes.get(AXIS_FSDP, 1) > 1
+        data_axes = _data_axes(axes, include_fsdp=params_sharded)
+        shape = tuple(int(s) for s in shape)  # host-sync-ok: static dims
+
+        spec = P()
+        for rule in self.rules:
+            if not rule.matches(path, len(shape), tensor_parallel):
+                continue
+            if rule.spec == LARGEST:
+                spec = _shard_largest(shape, data_axes, axes,
+                                      self.min_shard_size)
+            else:
+                entries = []
+                for dim, entry in zip(shape, rule.spec):
+                    if entry == DATA:
+                        entries.append(_data_slot(dim, data_axes, axes))
+                    else:
+                        entries.append(entry)
+                spec = P(*entries)
+            break
+
+        if moments and spec == P():
+            moments_sharded = zero_stage >= 1 and axes.get(AXIS_FSDP, 1) > 1
+            if moments_sharded:
+                spec = _shard_largest(
+                    shape, _data_axes(axes, include_fsdp=True), axes,
+                    self.min_shard_size,
+                )
+        return spec
+
+    # -- whole-tree resolution ----------------------------------------------
+
+    def tree_specs(
+        self,
+        tree: Any,
+        mesh_or_axes: Union[Mesh, Mapping[str, int], None],
+        zero_stage: int = 0,
+        tensor_parallel: Optional[bool] = None,
+        moments: bool = False,
+    ) -> Any:
+        """A pytree of PartitionSpec congruent with `tree`."""
+        import jax
+
+        def rule(path, leaf):
+            if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+                return P()
+            return self.resolve(
+                _path_str(path), tuple(leaf.shape), mesh_or_axes,
+                zero_stage=zero_stage, tensor_parallel=tensor_parallel,
+                moments=moments,
+            )
+
+        return jax.tree_util.tree_map_with_path(rule, tree)
+
+    # -- ledger pricing -----------------------------------------------------
+
+    def shard_fraction(
+        self,
+        tree: Any,
+        mesh_or_axes: Union[Mesh, Mapping[str, int], None],
+        zero_stage: int = 0,
+        tensor_parallel: Optional[bool] = None,
+        moments: bool = False,
+        itemsize: Optional[int] = None,
+    ) -> float:
+        """EXACT fraction of `tree`'s float bytes each chip holds at rest
+        under these rules — the registry-priced replacement for the analytic
+        ledgers' scalar `rest_shard_fraction` approximation.  Weighted by
+        leaf bytes (storage dtypes, or repriced at `itemsize`), so a small
+        unsharded norm vector barely moves it while an unsharded embedding
+        table shows up immediately."""
+        import jax
+        import jax.numpy as jnp
+
+        axes = _norm_axes(mesh_or_axes)
+        total = 0.0
+        held = 0.0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if not hasattr(leaf, "ndim"):
+                continue
+            dt = jnp.result_type(leaf)
+            if not jnp.issubdtype(dt, jnp.floating):
+                continue
+            nbytes = leaf.size * (itemsize if itemsize is not None
+                                  else jnp.dtype(dt).itemsize)
+            spec = self.resolve(
+                _path_str(path), tuple(leaf.shape), axes,
+                zero_stage=zero_stage, tensor_parallel=tensor_parallel,
+                moments=moments,
+            )
+            total += nbytes
+            held += nbytes / _spec_divisor(spec, axes)
+        return held / total if total else 1.0
+
+    # -- identity -----------------------------------------------------------
+
+    def describe(self) -> list:
+        """JSON-ready rule listing (the fingerprint's preimage; also what
+        tools/reshard.py prints)."""
+        return [
+            {
+                "pattern": r.pattern,
+                "spec": (r.spec if isinstance(r.spec, str)
+                         else [e for e in r.spec]),
+                "tp_only": r.tp_only,
+                "note": r.note,
+            }
+            for r in self.rules
+        ]
+
+    def fingerprint(self) -> str:
+        """Stable content hash of the rule table + resolution semantics.
+        Recorded in checkpoint meta (`topology_meta`); a resume under a
+        DIFFERENT fingerprint means the placement rules changed and a
+        mechanical reshard is not sufficient.  The free-text `note` is
+        excluded from the preimage — rewording documentation must not flag
+        every existing checkpoint as rules-changed."""
+        preimage = json.dumps(
+            {"semantics": _SEMANTICS_VERSION,
+             "min_shard_size": self.min_shard_size,
+             "rules": [{k: v for k, v in r.items() if k != "note"}
+                       for r in self.describe()]},
+            sort_keys=True,
+        )
+        return hashlib.sha256(preimage.encode()).hexdigest()[:16]
+
+
+_DEFAULT_REGISTRY = PartitionRegistry()
+
+
+def default_registry() -> PartitionRegistry:
+    """The process-wide default rule table (what `parallel/sharding.py`'s
+    param_specs/opt_state_specs delegate to)."""
+    return _DEFAULT_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# topology identity (checkpoint meta <-> live mesh)
+# ---------------------------------------------------------------------------
+
+def normalize_mesh_axes(mesh_or_axes: Union[Mesh, Mapping[str, int], None]) -> dict:
+    """{axis: size} with the size-1 axes dropped — the comparable identity
+    of a topology (dp8 saved as {dp:8,fsdp:1,...} equals {dp:8})."""
+    return {k: v for k, v in _norm_axes(mesh_or_axes).items() if v > 1}
+
+
+def meshes_equal(a: Union[Mesh, Mapping[str, int], None],
+                 b: Union[Mesh, Mapping[str, int], None]) -> bool:
+    return normalize_mesh_axes(a) == normalize_mesh_axes(b)
+
+
+def topology_meta(
+    mesh_or_axes: Union[Mesh, Mapping[str, int], None],
+    registry: Optional[PartitionRegistry] = None,
+    device_count: Optional[int] = None,
+) -> dict:
+    """The `topology` checkpoint-meta record: mesh shape, device count, and
+    the registry fingerprint.  `validate_checkpoint(expect_topology=...)`
+    compares this against the live run and raises ReshardRequired on a
+    mismatch instead of letting a cryptic unflatten failure surface."""
+    axes = _norm_axes(mesh_or_axes)
+    if device_count is None:
+        device_count = math.prod(axes.values()) if axes else 1
+    reg = registry if registry is not None else default_registry()
+    return {
+        "mesh": axes,
+        # host-sync-ok: a static python int (process/device count), never traced
+        "device_count": int(device_count),
+        "registry_fingerprint": reg.fingerprint(),
+    }

@@ -118,8 +118,16 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     settings: StepSettings = StepSettings(),
     pspecs: Any = None,
+    registry: Any = None,
 ):
     """Build (init_fn, step_fn).
+
+    `registry` (parallel/registry.PartitionRegistry, default the process
+    default) is the ONE source of truth for where params and optimizer
+    state live on the mesh — the same rule table checkpoint topology
+    records and the analytic comms/memory ledgers are priced from.
+    `pspecs` still overrides the param half for callers that hand-build
+    specs.
 
     init_fn(params) -> TrainState (sharded when a mesh is given).
     step_fn(state, batch, key) -> (state, metrics); batch leaves have leading
@@ -155,6 +163,10 @@ def make_train_step(
             f"(got param_dtype={settings.param_dtype})"
         )
 
+    from dalle_pytorch_tpu.parallel.registry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+
     def init_fn(params):
         if settings.param_dtype is not None:
             # storage in param_dtype; optimizer state derives from the f32
@@ -175,8 +187,10 @@ def make_train_step(
         state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
         if mesh is None:
             return state
-        ps = pspecs if pspecs is not None else param_specs(params, mesh, settings.zero_stage)
-        os_specs = opt_state_specs(opt_state, mesh, settings.zero_stage)
+        ps = pspecs if pspecs is not None else param_specs(
+            params, mesh, settings.zero_stage, registry=reg)
+        os_specs = opt_state_specs(opt_state, mesh, settings.zero_stage,
+                                   registry=reg)
         state_specs = TrainState(P(), ps, os_specs)
         return jax.tree_util.tree_map(
             lambda spec, leaf: jax.device_put(leaf, NamedSharding(mesh, spec)),
@@ -368,6 +382,7 @@ def make_train_step(
         # the TrainState — was actually aliased by the compiled executable
         jitted_single.donate_argnums = (0,)
         jitted_single.settings = settings
+        jitted_single.registry = reg
         return init_fn, jitted_single
 
     batch_sh = NamedSharding(mesh, P(BATCH_AXES))
@@ -397,6 +412,9 @@ def make_train_step(
     with_mesh_ctx.jitted = jitted
     with_mesh_ctx.mesh = mesh
     with_mesh_ctx.settings = settings
+    # the rule table the state was placed under — checkpoint topology
+    # stamping and the ledger re-pricing read it back from the step_fn
+    with_mesh_ctx.registry = reg
     # donation introspection for the memory stack's audit (argument 0, the
     # TrainState, must come back aliased from memory_analysis)
     with_mesh_ctx.donate_argnums = (0,)

@@ -314,6 +314,26 @@ def load_checkpoint(
     return trees, meta
 
 
+# --- topology stamping (elastic resume) -------------------------------------
+#
+# Every checkpoint records the topology it was written under — mesh shape,
+# device count, and the partitioning-registry fingerprint — so a resume can
+# tell "same rules, different topology" (reshard via parallel/reshard.py)
+# from "same topology" (restore as-is) from "different rules" (warn loudly).
+# The record is built by parallel/registry.topology_meta and stored under
+# TOPOLOGY_META_KEY by the CLIs' payload builders;
+# validate_checkpoint(expect_topology=...) raises ReshardRequired on a
+# mismatch instead of letting a cryptic unflatten failure surface.
+
+TOPOLOGY_META_KEY = "topology"
+
+
+def topology_from_meta(meta: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The topology record a checkpoint was saved under, or None for files
+    predating topology stamping (those restore exactly as before)."""
+    return (meta or {}).get(TOPOLOGY_META_KEY) or None
+
+
 # the `<name>_step<N>[.ext]` checkpoint naming convention, shared by
 # rotation ordering (below) and resume discovery (training/resilience.py) —
 # one regex so the two can never rank different file sets

@@ -63,7 +63,9 @@ __all__ = [
     "FutureFormatError",
     "MissingLeavesError",
     "NonFiniteCheckpointError",
+    "ReshardRequired",
     "RollbackRequested",
+    "check_topology",
     "ShutdownHandler",
     "TruncatedCheckpointError",
     "checkpoint_candidates",
@@ -292,7 +294,74 @@ class NonFiniteCheckpointError(CheckpointInvalidError):
     an earlier finite checkpoint."""
 
 
-def validate_checkpoint(path: str, check_finite: bool = False) -> Dict[str, Any]:
+class ReshardRequired(RuntimeError):
+    """The checkpoint was written under a DIFFERENT topology (mesh shape /
+    device count) or partitioning-registry fingerprint than the live run.
+
+    Deliberately NOT a CheckpointInvalidError: the file is perfectly good —
+    `--resume auto` must not fall back past it — it just cannot be restored
+    with the saved placement.  Callers catch this and reshard (the elastic
+    resume path: preflight the target topology's memory ledger, then
+    restore with the LIVE mesh's registry specs) instead of letting a
+    cryptic unflatten/placement failure surface.  `rules_changed` is the
+    severe half: the registry rule table itself differs, so the saved
+    placement is not merely a different shape of the same rules."""
+
+    def __init__(self, message: str, saved: Optional[Dict[str, Any]] = None,
+                 live: Optional[Dict[str, Any]] = None,
+                 rules_changed: bool = False):
+        super().__init__(message)
+        self.saved = saved or {}
+        self.live = live or {}
+        self.rules_changed = rules_changed
+
+
+def check_topology(meta: Optional[Dict[str, Any]],
+                   live_topology: Optional[Dict[str, Any]],
+                   path: str = "<checkpoint>") -> Optional[Dict[str, Any]]:
+    """Compare a checkpoint meta's `topology` record (parallel/registry.
+    topology_meta: mesh shape, device count, registry fingerprint) against
+    the live run's.  Raises ReshardRequired on any mismatch; returns the
+    saved record (or None when the checkpoint predates topology stamping —
+    old files restore as before, nothing to compare)."""
+    saved = (meta or {}).get("topology")
+    if not saved or not live_topology:
+        return None
+    from dalle_pytorch_tpu.parallel.registry import meshes_equal
+
+    saved_fp = saved.get("registry_fingerprint")
+    live_fp = live_topology.get("registry_fingerprint")
+    rules_changed = bool(saved_fp and live_fp and saved_fp != live_fp)
+    mesh_changed = not meshes_equal(saved.get("mesh"), live_topology.get("mesh"))
+    devices_changed = (
+        saved.get("device_count") is not None
+        and live_topology.get("device_count") is not None
+        and saved["device_count"] != live_topology["device_count"]
+    )
+    if not (rules_changed or mesh_changed or devices_changed):
+        return saved
+    what = []
+    if mesh_changed or devices_changed:
+        what.append(
+            f"mesh {saved.get('mesh')} ({saved.get('device_count')} devices)"
+            f" -> {live_topology.get('mesh')} "
+            f"({live_topology.get('device_count')} devices)"
+        )
+    if rules_changed:
+        what.append(
+            f"partitioning registry {saved_fp} -> {live_fp} (the RULES "
+            "changed, not just the topology)"
+        )
+    raise ReshardRequired(
+        f"checkpoint {path!r} was saved under a different topology: "
+        + "; ".join(what) + " — restore must reshard onto the live mesh",
+        saved=saved, live=live_topology, rules_changed=rules_changed,
+    )
+
+
+def validate_checkpoint(path: str, check_finite: bool = False,
+                        expect_topology: Optional[Dict[str, Any]] = None,
+                        ) -> Dict[str, Any]:
     """Cheap structural validation of an npz checkpoint WITHOUT loading the
     arrays: the zip archive opens, `__format` is readable by this loader,
     `__meta` parses as a JSON object, and every leaf named by each tree's
@@ -300,14 +369,80 @@ def validate_checkpoint(path: str, check_finite: bool = False) -> Dict[str, Any]
     distinct `CheckpointInvalidError` subclass per failure mode so logs say
     what actually happened (and `--resume auto` can fall back).
 
+    An orbax sharded checkpoint DIRECTORY validates structurally too: the
+    `state` payload exists, `meta.json` parses, and any VAE sidecar the
+    meta declares (vae_class_name -> vae.npz) is present — the writer lands
+    the sidecar before meta.json, so meta.json is the commit marker and a
+    torn directory fails here instead of crashing the restore.  The
+    per-leaf manifest screen is npz-only (orbax shards are opaque here; a
+    shard torn INSIDE `state` still only surfaces at restore), and
+    check_finite=True REJECTS directories outright (CheckpointInvalidError)
+    so the rollback screen falls back to an npz checkpoint it can actually
+    read rather than crashing on the directory.
+
     check_finite=True additionally reads every float leaf — low-precision
     (bf16) leaves are viewed back through the dtype sidecar first — and
     rejects NaN/Inf (NonFiniteCheckpointError): the ROLLBACK screen, which
     must not land on a checkpoint saved after the divergence it is rolling
-    back from.  (Costs a full file read.)"""
+    back from.  (Costs a full file read.)
+
+    expect_topology (parallel/registry.topology_meta of the LIVE run):
+    compare against the meta's recorded mesh shape / device count /
+    registry fingerprint and raise ReshardRequired — NOT a
+    CheckpointInvalidError; the file is resumable, it just needs the
+    elastic reshard path — on mismatch, instead of the cryptic
+    unflatten/placement failure the mismatch used to cause."""
     import numpy as np
 
     p = Path(path)
+    if p.is_dir():
+        # orbax sharded checkpoint directory
+        if not (p / "state").exists():
+            raise TruncatedCheckpointError(
+                f"checkpoint {path!r} is a directory without a 'state' "
+                "payload — not an orbax sharded checkpoint (or a torn one)"
+            )
+        meta_file = p / "meta.json"
+        if not meta_file.exists():
+            raise CheckpointMetaError(
+                f"checkpoint {path!r} has no meta.json record"
+            )
+        try:
+            meta = json.loads(meta_file.read_text())
+        except Exception as e:  # unicode, json — all corruption
+            raise CheckpointMetaError(
+                f"checkpoint {path!r}: meta.json is unreadable or not valid "
+                f"JSON ({e!r})"
+            ) from e
+        if not isinstance(meta, dict):
+            raise CheckpointMetaError(
+                f"checkpoint {path!r}: meta.json is {type(meta).__name__}, "
+                "expected a JSON object"
+            )
+        if meta.get("vae_class_name") and not (p / "vae.npz").exists():
+            # the meta itself declares a VAE sidecar the restore path will
+            # np.load — a directory missing it was torn mid-save (the
+            # writer now lands vae.npz BEFORE meta.json, but directories
+            # written under the old ordering, or copied incompletely, must
+            # still fail discovery rather than crash the resume)
+            raise TruncatedCheckpointError(
+                f"checkpoint {path!r}: meta.json declares a VAE sidecar "
+                "(vae_class_name) but vae.npz is missing — torn save"
+            )
+        if check_finite:
+            # the finite (ROLLBACK) screen must read every leaf, and orbax
+            # shards are opaque here — rollback covers npz only.  Report
+            # the directory as unusable for THIS screen so discovery falls
+            # back to the newest npz instead of the rollback reload
+            # crashing on np.load(<directory>).
+            raise CheckpointInvalidError(
+                f"checkpoint {path!r} is a sharded directory: the finite "
+                "(rollback) screen cannot read orbax shards — roll back to "
+                "an npz checkpoint instead"
+            )
+        if expect_topology is not None:
+            check_topology(meta, expect_topology, path=str(path))
+        return meta
     if not p.is_file():
         raise TruncatedCheckpointError(f"checkpoint {path!r} does not exist")
     try:
@@ -407,6 +542,8 @@ def validate_checkpoint(path: str, check_finite: bool = False) -> Dict[str, Any]
                         f"checkpoint {path!r}: leaf {key} contains NaN/Inf "
                         "— saved after a divergence; roll back further"
                     )
+    if expect_topology is not None:
+        check_topology(meta, expect_topology, path=str(path))
     return meta
 
 
@@ -416,13 +553,17 @@ _STEP_FILE_RE = checkpoint_mod.STEP_FILENAME_RE
 
 
 def _peek_global_step(path: Path) -> Optional[int]:
-    """Best-effort read of just the `__meta` global_step (one small zip
-    member) — used to RANK resume candidates; never trusted as validation."""
+    """Best-effort read of just the meta global_step (one small zip member,
+    or an orbax dir's meta.json) — used to RANK resume candidates; never
+    trusted as validation."""
     import numpy as np
 
     try:
-        with np.load(str(path), allow_pickle=False) as data:
-            meta = json.loads(bytes(data["__meta"]).decode())
+        if path.is_dir():
+            meta = json.loads((path / "meta.json").read_text())
+        else:
+            with np.load(str(path), allow_pickle=False) as data:
+                meta = json.loads(bytes(data["__meta"]).decode())
         step = meta.get("global_step")
         return step if isinstance(step, int) else None
     except Exception:  # noqa: BLE001 — corrupt files rank by filename only
@@ -437,12 +578,19 @@ def checkpoint_candidates(output_path: str) -> List[Path]:
     than every step file — and falls back to the step parsed from the
     FILENAME (mtime lies under clock skew / copies; a step file's meta step
     is filename step + 1, so the two scales agree).  In-progress `*.tmp`
-    files never qualify."""
+    files never qualify.  Orbax sharded checkpoint DIRECTORIES qualify the
+    same way (their step parses from the directory name; validation covers
+    their structure) — the discovery half of lifting PR 3's npz-only
+    `--resume auto` restriction."""
+    from dalle_pytorch_tpu.training.checkpoint import is_sharded_checkpoint
+
     out = Path(output_path)
     ranked: List[Tuple[int, int, int, Path]] = []
     for p in out.parent.glob(f"{out.stem}_step*"):
-        if p.name.endswith(".tmp") or p.is_dir():
+        if p.name.endswith(".tmp"):
             continue
+        if p.is_dir() and not is_sharded_checkpoint(str(p)):
+            continue  # an unrelated directory that happens to match the glob
         m = _STEP_FILE_RE.search(p.name)
         if not m:
             continue
@@ -453,7 +601,7 @@ def checkpoint_candidates(output_path: str) -> List[Path]:
         ranked.append(
             (step if step is not None else fname_step + 1, 1, fname_step, p)
         )
-    if out.is_file():
+    if out.is_file() or is_sharded_checkpoint(str(out)):
         step = _peek_global_step(out)
         ranked.append((step if step is not None else -1, 0, -1, out))
     ranked.sort(key=lambda t: t[:3], reverse=True)
@@ -550,6 +698,11 @@ FAULT_KINDS = (
     "oom",                # RESOURCE_EXHAUSTED at step N: real allocations on
     #                       TPU, a faithfully-shaped simulated error on CPU —
     #                       exercises the OOM forensic path (EXIT_OOM)
+    "shrink",             # elastic drill: SIGKILL self at step N; the
+    #                       supervisor relaunches on FEWER devices with
+    #                       --resume auto and the elastic resume reshards
+    #                       (tools/chaos.py `elastic` drives the full loop)
+    "grow",               # same drill, relaunched on MORE devices
 )
 
 
@@ -602,9 +755,20 @@ class FaultInjector:
         if self.fired or step < self.fault.step:
             return
         kind = self.fault.kind
-        if kind == "kill-process":
+        if kind in ("kill-process", "shrink", "grow"):
             self.fired = True
-            print(f"[chaos] SIGKILL self at step {step}", flush=True)
+            if kind == "kill-process":
+                print(f"[chaos] SIGKILL self at step {step}", flush=True)
+            else:
+                # the topology change itself happens at RELAUNCH — this
+                # process can only die where the drill says; the supervisor
+                # (tools/chaos.py elastic, or tests/test_resharding.py)
+                # restarts on a different device count with --resume auto
+                print(f"[chaos] {kind} drill: SIGKILL self at step {step}; "
+                      f"relaunch on a "
+                      f"{'smaller' if kind == 'shrink' else 'larger'} device "
+                      "count with --resume auto (elastic resume reshards)",
+                      flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
         elif kind == "preempt":
             self.fired = True

@@ -6,12 +6,18 @@ Two ways to break a training run on purpose:
 
 * **In-process** — pass `--inject_fault KIND@STEP` to train_dalle/train_vae
   (kinds: kill-process, preempt, corrupt-checkpoint, truncate-checkpoint,
-  stall-data, drop-remote-stream, oom; stall-data accepts `@STEP:SECONDS`).
-  The training loop drives the fault at exactly the named step — this is
-  what the crash-and-resume equivalence tests use.  `oom@STEP` provokes a
-  RESOURCE_EXHAUSTED (real allocations on TPU, a faithfully-shaped
-  simulated error on CPU) so the OOM forensic path — oom_report_*.txt +
-  exit code 77 — is exercisable end to end.
+  stall-data, drop-remote-stream, oom, shrink, grow; stall-data accepts
+  `@STEP:SECONDS`).  The training loop drives the fault at exactly the
+  named step — this is what the crash-and-resume equivalence tests use.
+  `oom@STEP` provokes a RESOURCE_EXHAUSTED (real allocations on TPU, a
+  faithfully-shaped simulated error on CPU) so the OOM forensic path —
+  oom_report_*.txt + exit code 77 — is exercisable end to end.
+  `shrink@STEP` / `grow@STEP` are the ELASTIC drills: the process SIGKILLs
+  itself at the step (a preemption that will hand back a different machine
+  shape) and the supervisor relaunches on a smaller / larger device count
+  with `--resume auto` — the elastic resume detects the topology change
+  (ReshardRequired), preflights the target's memory ledger, and reshards
+  through the partitioning registry instead of failing.
 * **From outside** — this CLI damages artifacts or signals a live run:
 
       python tools/chaos.py corrupt  CKPT.npz      # garbage bytes into it
@@ -20,10 +26,14 @@ Two ways to break a training run on purpose:
       python tools/chaos.py preempt  PID           # SIGTERM (graceful path)
       python tools/chaos.py kill     PID           # SIGKILL (hard crash)
 
+      # the full elastic drill, end to end (CPU devices, dummy model):
+      # run on 8 virtual devices, shrink@4, relaunch on 4, diff the losses
+      python tools/chaos.py elastic --devices 8 --resume_devices 4 --step 4
+
 The repeatable experiment: start a run with `--save_every_n_steps N`, break
 it (either way), restart with `--resume auto`, and diff the per-step loss
-sequence against an uninterrupted run — tests/test_resilience.py automates
-exactly that.
+sequence against an uninterrupted run — tests/test_resilience.py and the
+shrink-resume test in tests/test_resharding.py automate exactly that.
 """
 from __future__ import annotations
 
@@ -78,6 +88,24 @@ def main(argv=None) -> int:
     p = sub.add_parser("kill", help="SIGKILL a live run (hard crash)")
     p.add_argument("pid", type=int)
 
+    p = sub.add_parser(
+        "elastic",
+        help="shrink/grow drill: dummy-run train_dalle on N CPU devices "
+             "with --inject_fault shrink@STEP, relaunch with --resume auto "
+             "on M devices, and check the stitched loss trajectory")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU device count for the first run")
+    p.add_argument("--resume_devices", type=int, default=4,
+                   help="device count for the relaunch (fewer = shrink, "
+                        "more = grow)")
+    p.add_argument("--step", type=int, default=4, help="fault step")
+    p.add_argument("--steps", type=int, default=8, help="total dummy steps")
+    p.add_argument("--batch_size", type=int, default=8,
+                   help="global batch (pinned so both runs see the same "
+                        "data stream; must divide by both device counts)")
+    p.add_argument("--workdir", type=str, default=None,
+                   help="where run artifacts land (default: a tmp dir)")
+
     args = parser.parse_args(argv)
     if args.cmd == "corrupt":
         corrupt_file(args.path, nbytes=args.nbytes)
@@ -101,6 +129,95 @@ def main(argv=None) -> int:
     elif args.cmd == "kill":
         os.kill(args.pid, signal.SIGKILL)
         print(f"sent SIGKILL to {args.pid} (restart with --resume auto)")
+    elif args.cmd == "elastic":
+        return elastic_drill(
+            devices=args.devices, resume_devices=args.resume_devices,
+            step=args.step, steps=args.steps, batch_size=args.batch_size,
+            workdir=args.workdir,
+        )
+    return 0
+
+
+def _run_train(cli_args, cwd, devices, timeout=600):
+    """One train_dalle subprocess on `devices` virtual CPU devices — the
+    shared launch recipe (tests/test_resharding.py drives its subprocess
+    runs through this, so the env scrub stays in one place)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    # scrub any inherited device-count flag so OURS wins
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={devices}"])
+    return subprocess.run(
+        [sys.executable, "-m", "dalle_pytorch_tpu.cli.train_dalle",
+         *cli_args],
+        cwd=str(cwd), env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def elastic_drill(devices=8, resume_devices=4, step=4, steps=8,
+                  batch_size=8, workdir=None) -> int:
+    """The shrink/grow experiment end to end: SIGKILL at `step` on
+    `devices` CPU devices, relaunch on `resume_devices` with --resume auto,
+    and verify the stitched per-step loss trajectory is complete and
+    finite.  Returns 0 on success (also the engine behind the subprocess
+    test in tests/test_resharding.py)."""
+    import json
+    import tempfile
+
+    kind = "shrink" if resume_devices < devices else "grow"
+    cwd = Path(workdir) if workdir else Path(tempfile.mkdtemp(prefix="elastic_"))
+    cwd.mkdir(parents=True, exist_ok=True)
+    # a reused workdir must not poison this drill: stale metrics rows would
+    # fill gaps in the loss check (runs append to drill.metrics.jsonl) and
+    # stale checkpoints would hijack --resume auto's discovery
+    import shutil
+
+    for leftover in cwd.glob("drill*"):
+        shutil.rmtree(leftover) if leftover.is_dir() else leftover.unlink()
+    base = ["--dummy_run", str(steps), "--telemetry", "off",
+            "--log_every_n_steps", "1", "--batch_size", str(batch_size),
+            "--dalle_output_file_name", str(cwd / "drill")]
+    print(f"[elastic] phase 1: {devices} devices, --inject_fault "
+          f"{kind}@{step}  (workdir {cwd})")
+    a = _run_train(
+        [*base, "--save_every_n_steps", "1",
+         "--inject_fault", f"{kind}@{step}"], cwd, devices)
+    if a.returncode != -signal.SIGKILL:
+        print(f"[elastic] FAIL: expected SIGKILL death, got rc={a.returncode}"
+              f"\n{a.stderr[-2000:]}")
+        return 1
+    print(f"[elastic] phase 2: relaunch on {resume_devices} devices with "
+          "--resume auto")
+    b = _run_train(
+        [*base, "--save_every_n_steps", "0", "--resume", "auto"],
+        cwd, resume_devices)
+    if b.returncode != 0:
+        print(f"[elastic] FAIL: resume rc={b.returncode}\n{b.stderr[-2000:]}")
+        return 1
+    if "resharding onto the live mesh" not in b.stdout:
+        print("[elastic] FAIL: resume did not detect the topology change")
+        return 1
+    losses = {}
+    for line in open(cwd / "drill.metrics.jsonl"):
+        rec = json.loads(line)
+        if "loss" in rec:
+            losses[rec["step"]] = rec["loss"]
+    missing = [s for s in range(steps) if s not in losses]
+    bad = [s for s, v in losses.items() if v != v]  # NaN check
+    if missing or bad:
+        print(f"[elastic] FAIL: missing steps {missing}, NaN steps {bad}")
+        return 1
+    print(f"[elastic] OK: {kind} drill survived — all {steps} steps logged "
+          "finite losses across the topology change; trajectory: "
+          + ", ".join(f"{s}:{losses[s]:.4f}" for s in sorted(losses)))
     return 0
 
 

@@ -58,6 +58,12 @@ JIT_PURE = (
     # memory.py prices HBM from static shapes + host dicts only; its one
     # deliberate device touch (provoke_oom's chaos allocation) is waived
     "dalle_pytorch_tpu/observability/memory.py",
+    # the partitioning registry is pure path/shape arithmetic (it decides
+    # placement; it must never read a placed value), and the reshard
+    # utility runs host-side BETWEEN steps — its deliberate static-shape
+    # casts are waived line-by-line
+    "dalle_pytorch_tpu/parallel/registry.py",
+    "dalle_pytorch_tpu/parallel/reshard.py",
 )
 
 WAIVER = "host-sync-ok"

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Offline checkpoint reshard: rewrite a checkpoint saved under mesh A's
+topology for mesh B, with a memory-ledger dry run.
+
+The npz format stores every tree gathered to host, and orbax restores
+re-shard onto whatever mesh the restore step uses — so the PAYLOAD is
+already topology-portable.  What this tool does is make the move explicit
+and safe:
+
+  * `--dry_run` prints the per-chip AT-REST memory ledger for the TARGET
+    topology (params + gradient buffer + optimizer state at their exact
+    partitioning-registry shard fractions, parallel/reshard.py) and the
+    fits / does-not-fit verdict against per-chip HBM capacity — the answer
+    to "can I load this dp8 checkpoint onto tp4×dp2 for serving?" before
+    any chip is touched.
+  * Without `--dry_run`, the checkpoint's `topology` meta record is
+    rewritten to mesh B (+ the CURRENT registry fingerprint) — array bytes
+    are copied through untouched — so a subsequent `--resume auto` under
+    mesh B restores without the ReshardRequired detour.  A reshard the
+    ledger says cannot fit is REFUSED (exit 2) unless `--force`.
+
+Examples:
+
+    # would a dp8 training checkpoint fit a 2-chip serving mesh?
+    python tools/reshard.py dalle_step400.npz --mesh_dp 2 --dry_run
+
+    # rewrite it for tp4 x dp2 (refuses if the ledger says it can't fit)
+    python tools/reshard.py dalle_step400.npz --mesh_dp 2 --mesh_tp 4 \
+        --out dalle_serve.npz
+
+Works on npz checkpoints and orbax sharded checkpoint directories (the
+directory form rewrites meta.json only — shards re-lay themselves out at
+restore time)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dalle_pytorch_tpu.parallel.registry import (  # noqa: E402
+    default_registry,
+    normalize_mesh_axes,
+    topology_meta,
+)
+from dalle_pytorch_tpu.training import resilience  # noqa: E402
+from dalle_pytorch_tpu.training.checkpoint import (  # noqa: E402
+    is_sharded_checkpoint,
+    load_checkpoint,
+    topology_from_meta,
+)
+
+
+def _bundle_as_tree(tree):
+    """A TreeBundle (library-structured optimizer state) priced through its
+    OWN recorded key paths: a flat dict keyed by the joined path string, so
+    the registry's path rules see the same suffixes the live tree has."""
+    if hasattr(tree, "paths") and hasattr(tree, "leaves"):
+        return {
+            "/".join(str(seg[1]) for seg in path): leaf
+            for path, leaf in zip(tree.paths, tree.leaves)
+        }
+    return tree
+
+
+def _abstract_params_from_meta(meta: dict):
+    """Abstract (shape/dtype-only) DALLE param tree rebuilt from a
+    checkpoint's hparams via jax.eval_shape — no arrays materialize, so an
+    orbax directory's ledger can be priced without reading a single shard.
+    Returns None when the meta is not a DALLE checkpoint's."""
+    try:
+        import jax
+
+        from dalle_pytorch_tpu.models import dalle as dalle_mod
+        from dalle_pytorch_tpu.models.dalle import DALLEConfig
+
+        cfg = DALLEConfig.from_dict(meta["hparams"])
+        return jax.eval_shape(
+            lambda: dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg))
+    except Exception:
+        return None
+
+
+def _rewrite_meta_npz(src: str, dst: str, meta: dict,
+                      allow_pickle: bool = False) -> None:
+    """Re-write an npz checkpoint with only `__meta` replaced — every array
+    member (leaves, manifests, dtype sidecars) is copied through untouched,
+    with the same fsync-before-rename durability as save_checkpoint.
+    `allow_pickle` mirrors the loader's legacy opt-in: v1/v2 files store
+    their treedefs as pickled object arrays, which must round-trip too."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.training.checkpoint import _meta_default
+
+    with np.load(src, allow_pickle=allow_pickle) as data:
+        payload = {k: data[k] for k in data.files}
+    payload["__meta"] = np.frombuffer(
+        json.dumps(meta, default=_meta_default).encode(), dtype=np.uint8)
+    tmp = str(dst) + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
+def _format_ledger(ledger: dict) -> str:
+    lines = []
+    for row in ledger["rows"]:
+        lines.append(f"  {row['name']:<12} {row['bytes'] / 1e9:>8.3f} GB  "
+                     f"({row['detail']})")
+    cap = ledger.get("capacity_bytes")
+    fits = ledger.get("fits")
+    verdict = ("fits" if fits else "DOES NOT FIT" if fits is not None
+               else "capacity unknown — pass --hbm_gb to verdict")
+    lines.append(f"  {'total':<12} {ledger['total_bytes'] / 1e9:>8.3f} GB  "
+                 "per chip at rest (lower bound: no activations)")
+    if cap:
+        lines.append(f"  capacity     {cap / 1e9:>8.3f} GB  -> {verdict}")
+    else:
+        lines.append(f"  -> {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("checkpoint", help="npz checkpoint file or orbax "
+                        "sharded checkpoint directory")
+    parser.add_argument("--mesh_dp", type=int, default=1)
+    parser.add_argument("--mesh_fsdp", type=int, default=1)
+    parser.add_argument("--mesh_tp", type=int, default=1)
+    parser.add_argument("--mesh_sp", type=int, default=1)
+    parser.add_argument("--mesh_pp", type=int, default=1)
+    parser.add_argument("--zero_stage", type=int, default=0,
+                        choices=[0, 1, 2, 3],
+                        help="ZeRO stage the TARGET run will use (changes "
+                             "the at-rest fsdp shard fractions)")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the target topology's per-chip memory "
+                             "ledger verdict and exit without writing")
+    parser.add_argument("--out", type=str, default=None,
+                        help="output path (default: rewrite in place)")
+    parser.add_argument("--hbm_gb", type=float, default=None,
+                        help="per-chip HBM capacity in GB for the verdict "
+                             "(default: this host's devices, else unknown)")
+    parser.add_argument("--force", action="store_true",
+                        help="rewrite even when the ledger says the target "
+                             "cannot fit")
+    parser.add_argument("--allow_legacy_pickle", action="store_true",
+                        help="permit pre-v3 (pickled-treedef) checkpoints — "
+                             "trusted files only")
+    args = parser.parse_args(argv)
+
+    target_axes = {"dp": args.mesh_dp, "fsdp": args.mesh_fsdp,
+                   "tp": args.mesh_tp, "sp": args.mesh_sp, "pp": args.mesh_pp}
+    capacity = args.hbm_gb * 1e9 if args.hbm_gb else None
+    registry = default_registry()
+
+    # validate first: a torn file should say so, not stack-trace
+    try:
+        meta = resilience.validate_checkpoint(args.checkpoint)
+    except resilience.CheckpointInvalidError as e:
+        print(f"INVALID ({type(e).__name__}): {e}")
+        return 1
+
+    saved_topo = topology_from_meta(meta)
+    print(f"checkpoint: {args.checkpoint}")
+    print("  saved topology:  "
+          + (f"{saved_topo.get('mesh') or 'single chip'} "
+             f"({saved_topo.get('device_count')} devices, registry "
+             f"{saved_topo.get('registry_fingerprint')})" if saved_topo
+             else "<unrecorded (pre-topology checkpoint)>"))
+    print(f"  target topology: {normalize_mesh_axes(target_axes) or 'single chip'}"
+          f" (zero_stage {args.zero_stage}, registry {registry.fingerprint()})")
+
+    sharded = is_sharded_checkpoint(args.checkpoint)
+    weights = opt_state = None
+    abstract = False
+    if not sharded:
+        trees, meta = load_checkpoint(
+            args.checkpoint, allow_legacy_pickle=args.allow_legacy_pickle)
+        weights = trees.get("weights")
+        opt_state = _bundle_as_tree(trees.get("opt_state"))
+    else:
+        # no shard is read: the ledger prices abstract shapes rebuilt from
+        # the meta's hparams (optimizer moments estimated as adam), so the
+        # dry-run verdict and the fits-refusal apply to directories too
+        weights = _abstract_params_from_meta(meta)
+        abstract = weights is not None
+
+    if weights is not None:
+        if abstract:
+            print("(orbax directory: ledger priced from meta hparams via "
+                  "abstract shapes — no shards read; optimizer moments "
+                  "estimated as adam)")
+        from dalle_pytorch_tpu.parallel.reshard import reshard_preflight_ledger
+
+        ledger = reshard_preflight_ledger(
+            weights, opt_state, target_axes, zero_stage=args.zero_stage,
+            registry=registry, capacity_bytes=capacity,
+        )
+        print("per-chip at-rest ledger on the target topology:")
+        print(_format_ledger(ledger))
+        if ledger["fits"] is False and not args.force and not args.dry_run:
+            print("REFUSED: the target topology cannot hold this state "
+                  "(--force overrides; better: more chips, a higher "
+                  "--zero_stage, or bf16 storage)")
+            return 2
+    else:
+        print("(no ledger: the meta carries no priceable hparams — shards "
+              "re-lay themselves out at restore time and the live "
+              "preflight still gates the restore)")
+
+    if args.dry_run:
+        return 0
+
+    meta = dict(meta)
+    meta["topology"] = topology_meta(target_axes, registry)
+    if sharded:
+        out = Path(args.out) if args.out else Path(args.checkpoint)
+        if args.out and out.resolve() != Path(args.checkpoint).resolve():
+            import shutil
+
+            shutil.copytree(args.checkpoint, out, dirs_exist_ok=True)
+        # meta.json is the directory's commit marker: rewrite it atomically
+        # (tmp + fsync + rename, same durability as _rewrite_meta_npz) so a
+        # kill mid-rewrite cannot leave a truncated marker that fails
+        # validation on a checkpoint that was perfectly good before
+        tmp = out / "meta.json.tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(meta))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out / "meta.json")
+    else:
+        out = args.out or args.checkpoint
+        _rewrite_meta_npz(args.checkpoint, out, meta,
+                          allow_pickle=args.allow_legacy_pickle)
+    print(f"rewrote {out} for topology "
+          f"{normalize_mesh_axes(target_axes) or 'single chip'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
