@@ -457,6 +457,35 @@ def main():
         float(scores[0])
         gen_full_s_per_image = (time.perf_counter() - t0) / gen_batch
 
+    # serving row (ISSUE 8): the continuous-batching engine + paged KV pool
+    # under 2-stream Poisson load — p50/p99 time-to-first-token, per-request
+    # latency, and images/sec/chip, the SLO numbers the ROADMAP's serving
+    # north star is tracked by.  Codes-only (no VAE): the row isolates the
+    # engine + paged-decode path the subsystem added.
+    serving_row = None
+    try:
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+        PoissonLoadGen, synthetic_request_maker = _import_loadgen()
+
+        sparams = gen_params if on_tpu else state.params
+        s_engine = GenerationEngine(
+            sparams, cfg,
+            engine_cfg=EngineConfig(num_slots=2,
+                                    block_size=64 if on_tpu else 16),
+        )
+        s_gen = PoissonLoadGen(4, rate=2.0 if on_tpu else 5.0, streams=2, seed=0)
+        serving_row = s_gen.run(
+            s_engine, synthetic_request_maker(cfg, seed=0),
+            max_wall_s=600 if on_tpu else 300,
+        )
+        serving_row["paged_pool_mb"] = round(
+            s_engine.pool.bytes(2 if on_tpu else 4) / 1e6, 2)
+        serving_row["slots"] = 2
+    except Exception as e:  # the serving row must never sink the bench
+        serving_row = {"error": str(e)[:200]}
+
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
     # kept as a secondary row for cross-round continuity.  Each row runs as a
@@ -591,6 +620,7 @@ def main():
         "health_overhead": health_row,
         "async_checkpoint": async_checkpoint_row,
         "memory": memory_row,
+        "serving": serving_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

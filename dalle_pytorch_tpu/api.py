@@ -68,6 +68,10 @@ class DALLE:
         self.vae = vae
         self.cfg = _dalle.DALLEConfig.from_vae(vae.cfg, **cfg_kwargs)
         self.params = params if params is not None else _dalle.init_dalle(_as_key(key), self.cfg)
+        # AOT prefill/decode executables keyed by (batch, cond_scale,
+        # prime_len, filter_thres): repeated generate_images calls at the
+        # same shape never re-trace (hits/misses in the metrics registry)
+        self._exec_cache = _sampling.ExecutableCache()
 
     @property
     def text_seq_len(self):
@@ -94,13 +98,15 @@ class DALLE:
     forward = __call__
 
     def generate_images(self, text, key=0, clip=None, filter_thres=0.5, temperature=1.0,
-                        img=None, num_init_img_tokens=None, cond_scale=1.0):
+                        img=None, num_init_img_tokens=None, cond_scale=1.0,
+                        use_exec_cache=True):
         return _sampling.generate_images(
             self.params, self.cfg, self.vae.params, self.vae.cfg, text, _as_key(key),
             filter_thres=filter_thres, temperature=temperature, img=img,
             num_init_img_tokens=num_init_img_tokens, cond_scale=cond_scale,
             clip_params=clip.params if clip is not None else None,
             clip_cfg=clip.cfg if clip is not None else None,
+            exec_cache=self._exec_cache if use_exec_cache else None,
         )
 
     def generate_texts(self, tokenizer=None, text=None, key=0, filter_thres=0.5, temperature=1.0):

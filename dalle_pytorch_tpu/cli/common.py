@@ -1,6 +1,85 @@
 """Shared CLI helpers."""
 from __future__ import annotations
 
+from typing import Optional
+
+
+def load_dalle_bundle(path, allow_legacy_pickle: bool = False,
+                      vqgan_config_path: Optional[str] = None):
+    """Load a trained DALL-E checkpoint of any supported flavor — self-format
+    npz, orbax sharded directory, or torch-reference dalle.pt — returning
+    (dalle_cfg, params, vae_cfg, vae_params).  Shared by cli/generate.py and
+    cli/serve.py so the batch CLI and the long-lived service consume the
+    exact same loading/migration path."""
+    from pathlib import Path
+
+    from dalle_pytorch_tpu.models import vae_registry
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+    from dalle_pytorch_tpu.models.torch_port import (
+        is_torch_checkpoint,
+        load_reference_dalle_checkpoint,
+    )
+    from dalle_pytorch_tpu.training.checkpoint import (
+        is_sharded_checkpoint,
+        load_checkpoint,
+    )
+    from dalle_pytorch_tpu.version import __version__
+
+    path = Path(path)
+    assert path.exists(), f"trained DALL-E {path} does not exist"
+
+    if is_sharded_checkpoint(str(path)):
+        # orbax sharded training checkpoint (train_dalle --sharded_checkpoint):
+        # template-free restore of the weights only — inference must never
+        # materialize the optimizer moments (≈2× params of host memory)
+        from dalle_pytorch_tpu.training.checkpoint import load_sharded
+
+        restored, meta = load_sharded(str(path), only=("weights",))
+        vae_trees, vae_side_meta = load_checkpoint(
+            str(path / "vae.npz"), allow_legacy_pickle=allow_legacy_pickle
+        )
+        if meta.get("version") != __version__:
+            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
+        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
+        vae_cfg = vae_registry.config_from_meta(
+            vae_side_meta.get("vae_class_name", "DiscreteVAE"), vae_side_meta["vae_params"]
+        )
+        from dalle_pytorch_tpu.models import dalle as dalle_mod
+
+        # template-free restore rebuilds the file's own (possibly
+        # pre-round-5) structure — migrate like the npz branch does
+        params = dalle_mod.migrate_param_layout(restored["weights"], dalle_cfg)
+        vae_params = vae_trees["vae_weights"]
+    elif is_torch_checkpoint(str(path)):
+        # a dalle.pt trained with the torch reference — convert on load
+        taming_config = None
+        if vqgan_config_path:  # --taming is implied by the config path
+            from dalle_pytorch_tpu.models.pretrained import parse_taming_yaml
+
+            taming_config = parse_taming_yaml(vqgan_config_path)
+        ref = load_reference_dalle_checkpoint(str(path), taming_config=taming_config)
+        dalle_cfg, params = ref["config"], ref["params"]
+        vae_cfg, vae_params = ref["vae_config"], ref["vae_params"]
+        print(f"loaded reference-format checkpoint (version {ref.get('version')})")
+    else:
+        trees, meta = load_checkpoint(
+            str(path), allow_legacy_pickle=allow_legacy_pickle
+        )
+        if meta.get("version") != __version__:
+            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
+
+        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
+        # reference generate.py:94-101: reconstitute whichever VAE class the
+        # checkpoint was trained with
+        vae_cfg = vae_registry.config_from_meta(
+            meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
+        )
+        from dalle_pytorch_tpu.models import dalle as dalle_mod
+
+        params = dalle_mod.migrate_param_layout(trees["weights"], dalle_cfg)
+        vae_params = trees["vae_weights"]
+    return dalle_cfg, params, vae_cfg, vae_params
+
 
 def warn_vocab_mismatch(num_text_tokens: int, tokenizer, is_root: bool = True) -> None:
     """Out-of-vocab caption ids are clamped by the model (models/dalle.py);

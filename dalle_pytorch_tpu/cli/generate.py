@@ -14,12 +14,9 @@ import numpy as np
 
 from dalle_pytorch_tpu.data import tokenizer as tokenizer_mod
 from dalle_pytorch_tpu.models import vae_registry
-from dalle_pytorch_tpu.models.dalle import DALLEConfig
 from dalle_pytorch_tpu.models.sampling import generate_images, generate_texts
 from dalle_pytorch_tpu.observability import memory as memory_mod
 from dalle_pytorch_tpu.training import resilience
-from dalle_pytorch_tpu.training.checkpoint import load_checkpoint
-from dalle_pytorch_tpu.version import __version__
 
 
 def build_parser():
@@ -49,6 +46,17 @@ def build_parser():
                         help="permit loading pre-v3 (pickled-treedef) "
                              "checkpoints — trusted sources only (legacy "
                              "formats can execute code on load)")
+    parser.add_argument("--engine", action="store_true",
+                        help="route sampling through the continuous-batching "
+                             "serving engine (serving/) instead of the batch "
+                             "sampler: each image is its own request with its "
+                             "own PRNG stream (bit-identical to a batch-1 "
+                             "fused sample with that key), so the CLI and the "
+                             "service share one code path")
+    parser.add_argument("--engine_slots", type=int, default=4,
+                        help="decode slots for --engine")
+    parser.add_argument("--engine_block_size", type=int, default=64,
+                        help="KV pool block size (tokens) for --engine")
     return parser
 
 
@@ -71,65 +79,12 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
 
     path = Path(args.dalle_path)
-    assert path.exists(), f"trained DALL-E {path} does not exist"
+    from dalle_pytorch_tpu.cli.common import load_dalle_bundle
 
-    from dalle_pytorch_tpu.models.torch_port import (
-        is_torch_checkpoint,
-        load_reference_dalle_checkpoint,
+    dalle_cfg, params, vae_cfg, vae_params = load_dalle_bundle(
+        path, allow_legacy_pickle=args.allow_legacy_pickle,
+        vqgan_config_path=args.vqgan_config_path,
     )
-
-    from dalle_pytorch_tpu.training.checkpoint import is_sharded_checkpoint
-
-    if is_sharded_checkpoint(str(path)):
-        # orbax sharded training checkpoint (train_dalle --sharded_checkpoint):
-        # template-free restore of the weights only — inference must never
-        # materialize the optimizer moments (≈2× params of host memory)
-        from dalle_pytorch_tpu.training.checkpoint import load_sharded
-
-        restored, meta = load_sharded(str(path), only=("weights",))
-        vae_trees, vae_side_meta = load_checkpoint(
-            str(path / "vae.npz"), allow_legacy_pickle=args.allow_legacy_pickle
-        )
-        if meta.get("version") != __version__:
-            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
-        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
-        vae_cfg = vae_registry.config_from_meta(
-            vae_side_meta.get("vae_class_name", "DiscreteVAE"), vae_side_meta["vae_params"]
-        )
-        from dalle_pytorch_tpu.models import dalle as dalle_mod
-
-        # template-free restore rebuilds the file's own (possibly
-        # pre-round-5) structure — migrate like the npz branch does
-        params = dalle_mod.migrate_param_layout(restored["weights"], dalle_cfg)
-        vae_params = vae_trees["vae_weights"]
-    elif is_torch_checkpoint(str(path)):
-        # a dalle.pt trained with the torch reference — convert on load
-        taming_config = None
-        if args.vqgan_config_path:  # --taming is implied by the config path
-            from dalle_pytorch_tpu.models.pretrained import parse_taming_yaml
-
-            taming_config = parse_taming_yaml(args.vqgan_config_path)
-        ref = load_reference_dalle_checkpoint(str(path), taming_config=taming_config)
-        dalle_cfg, params = ref["config"], ref["params"]
-        vae_cfg, vae_params = ref["vae_config"], ref["vae_params"]
-        print(f"loaded reference-format checkpoint (version {ref.get('version')})")
-    else:
-        trees, meta = load_checkpoint(
-            str(path), allow_legacy_pickle=args.allow_legacy_pickle
-        )
-        if meta.get("version") != __version__:
-            print(f"note: checkpoint version {meta.get('version')} != library {__version__}")
-
-        dalle_cfg = DALLEConfig.from_dict(meta["hparams"])
-        # reference generate.py:94-101: reconstitute whichever VAE class the
-        # checkpoint was trained with
-        vae_cfg = vae_registry.config_from_meta(
-            meta.get("vae_class_name", "DiscreteVAE"), meta["vae_params"]
-        )
-        from dalle_pytorch_tpu.models import dalle as dalle_mod
-
-        params = dalle_mod.migrate_param_layout(trees["weights"], dalle_cfg)
-        vae_params = trees["vae_weights"]
 
     tokenizer = get_tokenizer(args)
     from dalle_pytorch_tpu.cli.common import warn_vocab_mismatch
@@ -164,10 +119,21 @@ def main(argv=None):
               f"{resilience.EXIT_OOM} (shrink --batch_size)", flush=True)
         raise SystemExit(resilience.EXIT_OOM)
 
+    engine = None
+    if args.engine:
+        from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+        engine = GenerationEngine(
+            params, dalle_cfg, vae_params, vae_cfg,
+            engine_cfg=EngineConfig(num_slots=args.engine_slots,
+                                    block_size=args.engine_block_size,
+                                    filter_thres=args.top_k),
+        )
+
     paths = []
     try:
         return _generate_all(args, params, dalle_cfg, vae_params, vae_cfg,
-                             tokenizer, key, outputs_dir, paths)
+                             tokenizer, key, outputs_dir, paths, engine=engine)
     except Exception as e:
         if memory_mod.is_oom_error(e):
             oom_bail(e)
@@ -175,7 +141,7 @@ def main(argv=None):
 
 
 def _generate_all(args, params, dalle_cfg, vae_params, vae_cfg, tokenizer,
-                  key, outputs_dir, paths):
+                  key, outputs_dir, paths, engine=None):
     for raw_text in args.text.split("|"):
         raw_text = raw_text.strip()
         if args.gentxt:
@@ -200,11 +166,21 @@ def _generate_all(args, params, dalle_cfg, vae_params, vae_cfg, tokenizer,
         for i in range(0, args.num_images, args.batch_size):
             chunk = jnp.asarray(text_tokens[i : i + args.batch_size])
             key, sk = jax.random.split(key)
-            images = generate_images(
-                params, dalle_cfg, vae_params, vae_cfg, chunk, sk,
-                filter_thres=args.top_k, temperature=args.temperature,
-                cond_scale=args.cond_scale,
-            )
+            if engine is not None:
+                # one request per image, each on its own derived key — each
+                # is bit-identical to a batch-1 fused sample with that key
+                row_keys = jax.random.split(sk, chunk.shape[0])
+                reqs = engine.generate(
+                    np.asarray(chunk), keys=list(row_keys),
+                    temperature=args.temperature, cond_scale=args.cond_scale,
+                )
+                images = jnp.asarray(np.concatenate([r.images for r in reqs]))
+            else:
+                images = generate_images(
+                    params, dalle_cfg, vae_params, vae_cfg, chunk, sk,
+                    filter_thres=args.top_k, temperature=args.temperature,
+                    cond_scale=args.cond_scale,
+                )
             from PIL import Image
 
             # display space (the reference's save_image(normalize=True),

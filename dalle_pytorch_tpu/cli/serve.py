@@ -1,0 +1,251 @@
+"""Long-lived generation service CLI.
+
+Two traffic sources:
+
+* `--prompts FILE` (or `-` for stdin): one prompt per line, all submitted
+  through the continuous-batching engine; images land under
+  `--outputs_dir/<prompt>/N.png` exactly like generate.py.
+* `--loadgen N`: N synthetic requests under `--streams` Poisson streams at
+  `--rate` req/s per stream (tools/loadgen.py) — the SLO bench mode, used
+  by bench.py's `serving` row and the chaos `flood` drill.
+
+Either way the run ends with an SLO report (p50/p99 time-to-first-token,
+p50/p99 request latency, images/sec/chip, refusals) printed and optionally
+written as JSON (`--report_json`).  `--inject_fault flood@ITER[:COUNT]`
+bursts synthetic requests into the queue mid-run so admission control can be
+drilled: the service must queue/refuse — never OOM (the paged pool is sized
+up front and the ledger-priced admission gate refuses what will not fit).
+
+Without `--dalle_path` a `--synthetic` random-init model serves (drills and
+smoke tests run without a trained checkpoint)."""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from dalle_pytorch_tpu.observability import memory as memory_mod
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.training import resilience
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description="DALL-E generation service")
+    src = parser.add_argument_group("model")
+    src.add_argument("--dalle_path", type=str, default=None)
+    src.add_argument("--allow_legacy_pickle", action="store_true")
+    src.add_argument("--vqgan_config_path", type=str, default=None)
+    src.add_argument("--synthetic", action="store_true",
+                     help="serve a random-init model (no checkpoint needed)")
+    src.add_argument("--dim", type=int, default=64)
+    src.add_argument("--depth", type=int, default=2)
+    src.add_argument("--heads", type=int, default=4)
+    src.add_argument("--dim_head", type=int, default=16)
+    src.add_argument("--text_seq_len", type=int, default=16)
+    src.add_argument("--num_text_tokens", type=int, default=256)
+    src.add_argument("--num_image_tokens", type=int, default=256)
+    src.add_argument("--image_fmap_size", type=int, default=8)
+
+    eng = parser.add_argument_group("engine")
+    eng.add_argument("--slots", type=int, default=4,
+                     help="concurrent decode slots (a guided request uses 2)")
+    eng.add_argument("--block_size", type=int, default=64,
+                     help="KV pool block size in tokens")
+    eng.add_argument("--num_blocks", type=int, default=None,
+                     help="KV pool size (default: slots x blocks/seq)")
+    eng.add_argument("--max_queue", type=int, default=64)
+    eng.add_argument("--headroom_frac", type=float, default=0.92,
+                     help="defer admissions above this live-HBM usage fraction")
+
+    traffic = parser.add_argument_group("traffic")
+    traffic.add_argument("--prompts", type=str, default=None,
+                         help="file of prompts (one per line), or - for stdin")
+    traffic.add_argument("--loadgen", type=int, default=0,
+                         help="generate N synthetic Poisson requests instead")
+    traffic.add_argument("--rate", type=float, default=2.0,
+                         help="loadgen requests/second per stream")
+    traffic.add_argument("--streams", type=int, default=2)
+    traffic.add_argument("--top_k", type=float, default=0.9)
+    traffic.add_argument("--temperature", type=float, default=1.0)
+    traffic.add_argument("--cond_scale", type=float, default=1.0)
+    traffic.add_argument("--seed", type=int, default=0)
+
+    parser.add_argument("--outputs_dir", type=str, default="./outputs")
+    parser.add_argument("--no_vae", action="store_true",
+                        help="skip VAE decode (codes-only serving: bench mode)")
+    parser.add_argument("--telemetry", type=str, default=None)
+    parser.add_argument("--report_json", type=str, default=None)
+    parser.add_argument("--inject_fault", type=str, default=None,
+                        help="chaos hook, e.g. flood@8:16 (see tools/chaos.py)")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    return parser
+
+
+def _build_model(args):
+    if args.dalle_path:
+        from dalle_pytorch_tpu.cli.common import load_dalle_bundle
+
+        return load_dalle_bundle(
+            args.dalle_path, allow_legacy_pickle=args.allow_legacy_pickle,
+            vqgan_config_path=args.vqgan_config_path,
+        )
+    assert args.synthetic, "provide --dalle_path or --synthetic"
+    from dalle_pytorch_tpu.models import dalle as dalle_mod
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+
+    cfg = DALLEConfig(
+        dim=args.dim, depth=args.depth, heads=args.heads, dim_head=args.dim_head,
+        num_text_tokens=args.num_text_tokens, text_seq_len=args.text_seq_len,
+        num_image_tokens=args.num_image_tokens,
+        image_fmap_size=args.image_fmap_size,
+    )
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(args.seed), cfg)
+    return cfg, params, None, None
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+    tele = None
+    if args.telemetry:
+        tele = telemetry.configure(args.telemetry, run_name="serve")
+
+    injector = None
+    if args.inject_fault:
+        injector = resilience.FaultInjector(
+            resilience.parse_fault(args.inject_fault)).install()
+
+    dalle_cfg, params, vae_cfg, vae_params = _build_model(args)
+    if args.no_vae:
+        vae_cfg = vae_params = None
+
+    engine = GenerationEngine(
+        params, dalle_cfg, vae_params, vae_cfg,
+        engine_cfg=EngineConfig(
+            num_slots=args.slots, block_size=args.block_size,
+            num_blocks=args.num_blocks, max_queue=args.max_queue,
+            headroom_frac=args.headroom_frac, filter_thres=args.top_k,
+        ),
+    )
+    ledger = engine.memory_ledger()
+    print("[serving] paged-pool ledger:")
+    print(memory_mod.format_ledger(ledger))
+
+    try:
+        report = _run_traffic(args, engine, dalle_cfg, vae_cfg)
+    except Exception as e:
+        if memory_mod.is_oom_error(e):
+            path = memory_mod.write_oom_report(
+                args.outputs_dir, error=e, phase="serving", ledger=ledger,
+                context={"slots": args.slots, "block_size": args.block_size,
+                         "num_blocks": engine.pool.num_blocks},
+            )
+            print(f"[memory] OUT OF MEMORY while serving: forensic report -> "
+                  f"{path or '<unwritable>'}; exiting "
+                  f"{resilience.EXIT_OOM}", flush=True)
+            raise SystemExit(resilience.EXIT_OOM)
+        raise
+    finally:
+        if injector is not None:
+            injector.uninstall()
+        if tele is not None:
+            tele.flush(fleet=False)
+            tele.close()
+
+    print("[serving] SLO report:")
+    for k, v in report.items():
+        print(f"  {k:>26}: {v}")
+    if args.report_json:
+        Path(args.report_json).write_text(json.dumps(report))
+    return report
+
+
+def _import_loadgen():
+    """tools/ is not an installed package — fall back to a path import when
+    the repo root is not already on sys.path."""
+    try:
+        from tools.loadgen import PoissonLoadGen, synthetic_request_maker
+    except ImportError:
+        import sys
+
+        sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+        from loadgen import PoissonLoadGen, synthetic_request_maker
+    return PoissonLoadGen, synthetic_request_maker
+
+
+def _run_traffic(args, engine, dalle_cfg, vae_cfg):
+    import sys
+    import time
+
+    PoissonLoadGen, synthetic_request_maker = _import_loadgen()
+
+    if args.loadgen:
+        gen = PoissonLoadGen(args.loadgen, args.rate, streams=args.streams,
+                             seed=args.seed)
+        report = gen.run(engine, synthetic_request_maker(
+            dalle_cfg, seed=args.seed, temperature=args.temperature,
+            cond_scale=args.cond_scale,
+        ))
+    else:
+        assert args.prompts, "provide --loadgen N or --prompts FILE"
+        from dalle_pytorch_tpu.cli.generate import get_tokenizer
+
+        tokenizer = get_tokenizer(args)
+        lines = (sys.stdin if args.prompts == "-"
+                 else open(args.prompts)).read().splitlines()
+        lines = [ln.strip() for ln in lines if ln.strip()]
+        t0 = time.monotonic()
+        reqs, prompts = [], []
+        for i, prompt in enumerate(lines):
+            toks = tokenizer.tokenize(prompt, dalle_cfg.text_seq_len,
+                                      truncate_text=True)
+            # blocking submit: a full queue waits (backpressure) rather than
+            # refusing a batch caller; can-never-fit still raises
+            reqs.append(engine.submit_when_able(
+                np.asarray(toks)[0],
+                key=jax.random.PRNGKey(args.seed + i),
+                temperature=args.temperature,
+                cond_scale=args.cond_scale))
+            prompts.append(prompt)
+        engine.run_until_idle()
+        elapsed = time.monotonic() - t0
+        # report over ALL submitted requests — completions drained by the
+        # blocking submits' internal polls must count too
+        done = [r for r in reqs if r.codes is not None]
+        if any(r.images is not None for r in done):
+            _save_images(args, vae_cfg, reqs, prompts)
+        report = PoissonLoadGen(max(len(lines), 1), 1.0).report(
+            done, refused=0, elapsed_s=elapsed)
+    report["pool_blocks"] = engine.pool.num_blocks
+    report["refused_total"] = obs_metrics.counter("serving/refused").value
+    report["backpressure_alarms"] = obs_metrics.counter(
+        "serving_backpressure_alarms").value
+    return report
+
+
+def _save_images(args, vae_cfg, reqs, prompts):
+    from PIL import Image
+
+    from dalle_pytorch_tpu.models import vae_registry
+
+    outputs_dir = Path(args.outputs_dir)
+    for req, prompt in zip(reqs, prompts):
+        if req.images is None:
+            continue
+        out_dir = outputs_dir / prompt.replace(" ", "_")[:100]
+        out_dir.mkdir(parents=True, exist_ok=True)
+        images = vae_registry.to_display(vae_cfg, req.images)
+        arr = (np.clip(np.asarray(images)[0], 0, 1) * 255).astype(np.uint8)
+        n = len(list(out_dir.glob("*.png")))
+        Image.fromarray(arr.squeeze()).save(out_dir / f"{n}.png")
+
+
+if __name__ == "__main__":
+    main()

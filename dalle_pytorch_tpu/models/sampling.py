@@ -232,6 +232,76 @@ def sample_image_codes(
     )
 
 
+class ExecutableCache:
+    """AOT-compiled prefill/decode executables keyed by (batch, cond_scale,
+    prime_len, filter_thres).
+
+    `jax.jit` already caches traces per (shapes, statics), but every
+    dispatch still walks the trace-cache lookup, canonicalizes statics, and
+    — after anything flushed the global jit caches (telemetry lowering,
+    cross-checks) — silently re-traces.  A serving-adjacent caller (api.DALLE
+    repeatedly sampling the same batch shape) instead holds the COMPILED
+    executables and invokes them directly: zero retrace risk, and the
+    hit/miss counters make the compile bill observable
+    (`gen/exec_cache_hits` / `gen/exec_cache_misses`).  Temperature and the
+    PRNG key stay dynamic, so neither is part of the cache key."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def _key(self, text, cond_scale, prime_len, filter_thres):
+        return (int(text.shape[0]), float(cond_scale), int(prime_len),
+                float(filter_thres))
+
+    def entries(self):
+        return dict(self._cache)
+
+    def get_or_compile(self, params, cfg, text, primer_codes, prime_len,
+                       cond_scale, filter_thres, key, temperature):
+        k = self._key(text, cond_scale, prime_len, filter_thres)
+        entry = self._cache.get(k)
+        if entry is not None:
+            obs_metrics.counter("gen/exec_cache_hits").inc()
+            return entry
+        obs_metrics.counter("gen/exec_cache_misses").inc()
+        pre = _prefill_jit.lower(
+            params, cfg, text, primer_codes, prime_len, cond_scale
+        ).compile()
+        abs_cache, abs_logits = jax.eval_shape(
+            lambda p, t, pc: _prefill_phase(p, cfg, t, pc, prime_len, cond_scale),
+            params, text, primer_codes,
+        )
+        dec = _decode_jit.lower(
+            params, cfg, abs_cache, abs_logits, key, filter_thres,
+            temperature, cond_scale, primer_codes, prime_len, None,
+            collect_stats=False,
+        ).compile()
+        entry = (pre, dec)
+        self._cache[k] = entry
+        return entry
+
+    def sample(self, params, cfg, text, key, filter_thres, temperature,
+               cond_scale, primer_codes, prime_len):
+        """Codes via the cached executables, with per-phase wall-clock.
+        Returns (codes, prefill_s, decode_s).  `temperature` stays a python
+        float (WEAK dtype) so promotion inside the executable matches the
+        jitted path bit-for-bit under low-precision params."""
+        temperature = float(temperature)
+        pre, dec = self.get_or_compile(
+            params, cfg, text, primer_codes, prime_len, cond_scale,
+            filter_thres, key, temperature,
+        )
+        t0 = time.perf_counter()
+        cache, last_logits = pre(params, text, primer_codes)
+        jax.block_until_ready(last_logits)
+        prefill_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        codes = dec(params, cache, last_logits, key, temperature,
+                    primer_codes, None)
+        jax.block_until_ready(codes)
+        return codes, prefill_s, time.perf_counter() - t0
+
+
 def generate_images(
     params: dict,
     cfg: DALLEConfig,
@@ -246,6 +316,7 @@ def generate_images(
     cond_scale: float = 1.0,
     clip_params: Optional[dict] = None,
     clip_cfg=None,
+    exec_cache: Optional[ExecutableCache] = None,
 ):
     """Full pipeline: sample codes, decode through the VAE (any family —
     DiscreteVAE / VQGAN / OpenAI dVAE, dispatched on the config type),
@@ -277,6 +348,35 @@ def generate_images(
     b = int(text.shape[0])
     n_gen = cfg.image_seq_len - prime_len
     tele = telemetry.active()
+    if exec_cache is not None:
+        import contextlib
+
+        suspend = (tele.compile_watcher.suspended()
+                   if tele is not None and tele.compile_watcher is not None
+                   else contextlib.nullcontext())
+        with suspend:
+            try:
+                codes, prefill_s, decode_s = exec_cache.sample(
+                    params, cfg, text, key, filter_thres, temperature,
+                    cond_scale, primer, prime_len,
+                )
+            except Exception:
+                # AOT path unavailable on this backend/config — fall back to
+                # the jitted path (counted so the fallback is observable)
+                obs_metrics.counter("gen/exec_cache_fallbacks").inc()
+                codes, prefill_s, decode_s = None, None, None
+        if codes is not None and tele is not None:
+            obs_metrics.histogram("gen/prefill_s").observe(prefill_s)
+            obs_metrics.histogram("gen/decode_s").observe(decode_s)
+            obs_metrics.counter("gen/images").inc(b)
+            obs_metrics.counter("gen/image_tokens").inc(b * n_gen)
+            obs_metrics.gauge("gen/image_tokens_per_sec").set(
+                b * n_gen / max(decode_s, 1e-9)
+            )
+        if codes is not None:
+            return _finish_generate(
+                vae_params, vae_cfg, text, codes, clip_params, clip_cfg,
+            )
     if tele is None:
         codes = sample_image_codes(
             params, cfg, text, key,
@@ -326,6 +426,15 @@ def generate_images(
             obs_metrics.counter("gen/cfg_extra_token_evals").inc(
                 b * (cfg.text_seq_len + 1 + cfg.image_seq_len)
             )
+
+    return _finish_generate(vae_params, vae_cfg, text, codes, clip_params, clip_cfg)
+
+
+def _finish_generate(vae_params, vae_cfg, text, codes, clip_params, clip_cfg):
+    """The shared pipeline tail: VAE decode (+ timing) and optional CLIP
+    rerank — used by both the jitted and the exec-cached sampling paths."""
+    from dalle_pytorch_tpu.models import clip as clip_mod
+    from dalle_pytorch_tpu.models import vae_registry
 
     t0 = time.perf_counter()
     images = vae_registry.decode_indices(vae_params, vae_cfg, codes)

@@ -1085,3 +1085,320 @@ def _fill_ring(cfg: TransformerConfig, rb: jnp.ndarray, pre_shift: jnp.ndarray) 
         slot = img_pos % fmap
         rb = rb.at[:, slot].set(pairs[:, t])
     return rb
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache (serving/ continuous batching)
+# ---------------------------------------------------------------------------
+#
+# The dense cache above allocates (b, h, seq_len, dh) per layer per request
+# batch — one request's worth of HBM whether the sequence has generated 3
+# tokens or 1000.  The serving engine instead shares ONE preallocated block
+# pool across all in-flight sequences: per layer, (num_blocks, h, block_size,
+# dh) k/v arrays addressed through per-slot int32 block tables.  Shapes stay
+# static (XLA requirement); raggedness lives entirely in the block-table
+# *values* and the per-slot `offsets` vector, so admitting or evicting a
+# sequence never recompiles anything.
+#
+# Bit parity with the dense path is by construction: each slot's attention
+# runs the SAME `_attention_cached` math on a dense (h, seq_len, dh) view
+# gathered from its blocks (vmapped over slots with a per-slot offset).
+# Positions past a slot's offset hold stale bytes from evicted sequences,
+# but `attend` masks them to finfo.min BEFORE the softmax — exp underflows
+# to exactly 0.0 — so they contribute exactly nothing, same as the dense
+# cache's zeros.  The gathered view is a transient: only ONE layer's view is
+# live at a time, so the decode working set is dense/depth while the at-rest
+# footprint is just the pool (priced by sampling_memory_ledger's paged rows).
+
+
+def paged_blocks_per_seq(cfg: TransformerConfig, block_size: int) -> int:
+    """Blocks a full sequence occupies (the admission-control unit)."""
+    return -(-cfg.seq_len // block_size)
+
+
+def init_paged_pool(
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.float32
+) -> dict:
+    """One shared KV block pool: per layer, (num_blocks, heads, block_size,
+    dim_head) k/v arrays (stacked along a leading depth axis under
+    scan_layers, mirroring init_cache).  Block 0 is conventionally reserved
+    by the serving pool as the trash block inactive slots write into."""
+
+    def entry(lead=()):
+        shape = (*lead, num_blocks, cfg.heads, block_size, cfg.dim_head)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    if cfg.scan_layers:
+        layers = entry(lead=(cfg.depth,))
+    else:
+        layers = [entry() for _ in range(cfg.depth)]
+    return {"layers": layers}
+
+
+def init_slot_rings(
+    cfg: TransformerConfig, num_slots: int, dtype=jnp.float32
+) -> Optional[dict]:
+    """Per-slot token-shift ring buffers (slot-resident, not paged — they are
+    O(fmap * dim) per slot, dwarfed by the KV blocks).  None when the config
+    has no token shift."""
+    if not cfg.shift_tokens:
+        return None
+    q = cfg.dim // 4
+    fmap = cfg.image_fmap_size
+
+    def entry(lead=()):
+        return {
+            "shift_attn": jnp.zeros((*lead, num_slots, fmap, 2, q), dtype),
+            "shift_ff": jnp.zeros((*lead, num_slots, fmap, 2, q), dtype),
+        }
+
+    if cfg.scan_layers:
+        layers = entry(lead=(cfg.depth,))
+    else:
+        layers = [entry() for _ in range(cfg.depth)]
+    return {"layers": layers}
+
+
+def write_prefill_to_pool(
+    cfg: TransformerConfig,
+    pool: dict,
+    block_tables: jnp.ndarray,
+    cache_layers,
+    n_pre: int,
+    block_size: int,
+) -> dict:
+    """Scatter a freshly prefilled DENSE cache's first `n_pre` positions into
+    the block pool — prefill itself runs the existing `prefill` (identical
+    math, so parity is free) and this is pure data movement.  `block_tables`:
+    (b, max_blocks) physical block ids for the b newly admitted slots;
+    `cache_layers`: the `layers` entry of the cache `prefill` returned."""
+    nb = -(-n_pre // block_size)
+    pad = nb * block_size - n_pre
+
+    def pack(k):
+        # (..., b, h, seq, dh) -> (..., b, nb, h, block_size, dh)
+        k = k[..., :n_pre, :]
+        if pad:
+            padw = [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)]
+            k = jnp.pad(k, padw)
+        *lead, b, h, _, dh = k.shape
+        k = k.reshape(*lead, b, h, nb, block_size, dh)
+        return jnp.swapaxes(k, -4, -3)
+
+    tbl = block_tables[:, :nb]
+    if cfg.scan_layers:
+        lp = pool["layers"]
+        new_layers = dict(
+            lp,
+            k=lp["k"].at[:, tbl].set(pack(cache_layers["k"]).astype(lp["k"].dtype)),
+            v=lp["v"].at[:, tbl].set(pack(cache_layers["v"]).astype(lp["v"].dtype)),
+        )
+        return {"layers": new_layers}
+    new_layers = []
+    for lp, lc in zip(pool["layers"], cache_layers):
+        new_layers.append(dict(
+            lp,
+            k=lp["k"].at[tbl].set(pack(lc["k"]).astype(lp["k"].dtype)),
+            v=lp["v"].at[tbl].set(pack(lc["v"]).astype(lp["v"].dtype)),
+        ))
+    return {"layers": new_layers}
+
+
+def _paged_attention_step(shared, cfg, layer_pool, block_tables, offsets, x,
+                          pattern, rotary):
+    """Per-slot cached attention over the paged pool.  x: (S, 1, dim);
+    block_tables: (S, max_blocks); offsets: (S,).  Each slot gathers its
+    blocks into a dense (h, seq_len, dh) view and runs the SAME
+    `_attention_cached` math (vmapped), so results are bit-identical to the
+    dense cache.  Returns (out (S, 1, dim), (new_k, new_v) (S, h, dh)) —
+    the new column, for the caller to scatter back into the pool."""
+    seq = cfg.seq_len
+
+    def one(x_s, bt_s, off_s):
+        k = jnp.take(layer_pool["k"], bt_s, axis=0)  # (B, h, bs, dh)
+        v = jnp.take(layer_pool["v"], bt_s, axis=0)
+        k = k.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
+        v = v.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
+        out, (k2, v2) = _attention_cached(
+            shared, cfg, {"k": k, "v": v}, x_s[None], pattern, rotary, off_s
+        )
+        new_k = jax.lax.dynamic_slice(
+            k2, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
+        new_v = jax.lax.dynamic_slice(
+            v2, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
+        return out[0], new_k[0, :, 0], new_v[0, :, 0]
+
+    out, nk, nv = jax.vmap(one)(x, block_tables, offsets)
+    return out, (nk, nv)
+
+
+def _paged_scatter_cols(layer_pool, block_tables, offsets, cols, block_size: int):
+    """Write each slot's new KV column into its pool block.  Inactive slots
+    share the trash block (their tables are all-zero), so their duplicate
+    scatter indices can only clobber garbage."""
+    nk, nv = cols
+    bids = jnp.take_along_axis(
+        block_tables, (offsets // block_size)[:, None], axis=1)[:, 0]
+    within = offsets % block_size
+    return dict(
+        layer_pool,
+        k=layer_pool["k"].at[bids, :, within, :].set(nk.astype(layer_pool["k"].dtype)),
+        v=layer_pool["v"].at[bids, :, within, :].set(nv.astype(layer_pool["v"].dtype)),
+    )
+
+
+def _paged_shift_step(cfg, ring, x, offsets):
+    """Per-slot cached token shift: vmap of `_shift_cached_step` with a
+    per-slot offset.  ring: (S, fmap, 2, q); x: (S, 1, dim)."""
+
+    def one(rb, x_s, off_s):
+        shifted, rb2 = _shift_cached_step(cfg, rb[None], x_s[None], off_s)
+        return shifted[0], rb2[0]
+
+    return jax.vmap(one)(ring, x, offsets)
+
+
+def _paged_branch(cfg, wrap, attn_params, ff_params, x, kind, layer_pool,
+                  block_tables, offsets, ring, pattern, rotary):
+    """Decode-mode residual branch over paged per-slot state — the same
+    composition as `_residual_branch(mode='decode')` with vectors where that
+    path has scalars.  Returns (branch out, new ring, new KV cols or None)."""
+    h = layer_norm(wrap[f"{kind}_norm"], x)
+    new_ring = ring
+    if cfg.shift_tokens:
+        h, new_ring = _paged_shift_step(cfg, ring, h, offsets)
+    cols = None
+    if kind == "attn":
+        h, cols = _paged_attention_step(
+            attn_params, cfg, layer_pool, block_tables, offsets, h, pattern, rotary
+        )
+    else:
+        h = _feed_forward(ff_params, cfg, h, None)
+    if cfg.sandwich_norm:
+        h = layer_norm(wrap[f"{kind}_norm_out"], h)
+    return h * wrap[f"{kind}_scale"].astype(h.dtype), new_ring, cols
+
+
+def paged_decode_step(
+    params: dict,
+    cfg: TransformerConfig,
+    x: jnp.ndarray,
+    pool: dict,
+    block_tables: jnp.ndarray,
+    offsets: jnp.ndarray,
+    rings: Optional[dict],
+    block_size: int,
+) -> Tuple[jnp.ndarray, dict, Optional[dict]]:
+    """One decode step for a whole SLOT BATCH of independent sequences at
+    per-slot positions.  x: (S, 1, dim) embedded tokens; `offsets`: (S,)
+    per-slot cache offsets (the position each slot's token occupies);
+    `rings`: init_slot_rings state or None.  Returns (out (S, 1, dim),
+    new pool, new rings).  The serving engine's fused per-iteration decode."""
+    specs = derive_layer_specs(cfg)
+    rotary = transformer_rotary(cfg)
+    assert block_tables.shape[1] * block_size >= cfg.seq_len, (
+        "block tables must cover a full sequence: "
+        f"{block_tables.shape[1]} x {block_size} < {cfg.seq_len}"
+    )
+
+    if cfg.scan_layers:
+        return _paged_decode_scan(
+            params, cfg, specs, x, pool, block_tables, offsets, rings,
+            block_size, rotary,
+        )
+
+    patterns = spec_patterns(cfg, specs)
+
+    def branch(spec, h, kind, layer_pool, ring):
+        return _paged_branch(
+            cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
+            params["shared_ff"][spec.ff_id], h, kind, layer_pool, block_tables,
+            offsets, ring, patterns[_pattern_key(spec)], rotary,
+        )
+
+    new_pool_layers, new_ring_layers = [], []
+
+    def run_layer(spec, h):
+        """One layer's decode-mode residual pair on the paged state: returns
+        (fa, fb, new layer pool, new ring layer) with fb computed on h + fa."""
+        lp = pool["layers"][spec.index]
+        ring_layer = rings["layers"][spec.index] if cfg.shift_tokens else None
+        r_attn = ring_layer["shift_attn"] if cfg.shift_tokens else None
+        fa, r_attn, cols = branch(spec, h, "attn", lp, r_attn)
+        lp = _paged_scatter_cols(lp, block_tables, offsets, cols, block_size)
+        r_ff = ring_layer["shift_ff"] if cfg.shift_tokens else None
+        fb, r_ff, _ = branch(spec, h + fa, "ff", lp, r_ff)
+        new_ring = (
+            {"shift_attn": r_attn, "shift_ff": r_ff} if cfg.shift_tokens else None
+        )
+        return fa, fb, lp, new_ring
+
+    if cfg.execution == "reversible":
+        x1 = x2 = x
+        for spec in specs:
+            lp0 = pool["layers"][spec.index]
+            ring_layer = rings["layers"][spec.index] if cfg.shift_tokens else None
+            r_attn = ring_layer["shift_attn"] if cfg.shift_tokens else None
+            fa, r_attn, cols = branch(spec, x2, "attn", lp0, r_attn)
+            lp = _paged_scatter_cols(lp0, block_tables, offsets, cols, block_size)
+            x1 = x1 + fa
+            r_ff = ring_layer["shift_ff"] if cfg.shift_tokens else None
+            fb, r_ff, _ = branch(spec, x1, "ff", lp, r_ff)
+            x2 = x2 + fb
+            new_pool_layers.append(lp)
+            if cfg.shift_tokens:
+                new_ring_layers.append({"shift_attn": r_attn, "shift_ff": r_ff})
+        out = (x1 + x2) / 2
+    else:
+        h = x
+        for spec in specs:
+            fa, fb, lp, new_ring = run_layer(spec, h)
+            h = h + fa + fb
+            new_pool_layers.append(lp)
+            if cfg.shift_tokens:
+                new_ring_layers.append(new_ring)
+        out = h
+
+    new_rings = {"layers": new_ring_layers} if cfg.shift_tokens else None
+    return out, {"layers": new_pool_layers}, new_rings
+
+
+def _paged_decode_scan(params, cfg, specs, x, pool, block_tables, offsets,
+                       rings, block_size, rotary):
+    """scan_layers paged decode: one lax.scan over stacked params + stacked
+    pool blocks (+ stacked rings), per-layer pattern selected by traced
+    index — the paged mirror of `_run_cached_scan(mode='decode')`."""
+    _assert_scannable(cfg, specs)
+    masks_np, midx = _stacked_masks(cfg, specs, cfg.seq_len)
+    masks = jnp.asarray(masks_np)
+    stacked = _stacked_bundles(params, specs)
+
+    def body(h, xs):
+        if cfg.shift_tokens:
+            bundle, mi, lp, ring_layer = xs
+        else:
+            bundle, mi, lp = xs
+            ring_layer = None
+        mask = jnp.take(masks, mi, axis=0)
+        r_attn = ring_layer["shift_attn"] if cfg.shift_tokens else None
+        fa, r_attn, cols = _paged_branch(
+            cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "attn",
+            lp, block_tables, offsets, r_attn, mask, rotary,
+        )
+        lp = _paged_scatter_cols(lp, block_tables, offsets, cols, block_size)
+        h = h + fa
+        r_ff = ring_layer["shift_ff"] if cfg.shift_tokens else None
+        fb, r_ff, _ = _paged_branch(
+            cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "ff",
+            lp, block_tables, offsets, r_ff, mask, rotary,
+        )
+        ys = (lp, {"shift_attn": r_attn, "shift_ff": r_ff}) if cfg.shift_tokens else lp
+        return h + fb, ys
+
+    if cfg.shift_tokens:
+        xs = (stacked, midx, pool["layers"], rings["layers"])
+        out, (new_pool_layers, new_ring_layers) = jax.lax.scan(body, x, xs)
+        return out, {"layers": new_pool_layers}, {"layers": new_ring_layers}
+    xs = (stacked, midx, pool["layers"])
+    out, new_pool_layers = jax.lax.scan(body, x, xs)
+    return out, {"layers": new_pool_layers}, None

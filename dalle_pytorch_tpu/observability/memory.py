@@ -434,10 +434,18 @@ def generic_memory_ledger(params: Any, opt_state: Any = None,
 
 def sampling_memory_ledger(cfg: Any, batch: int, params: Any = None,
                            itemsize: Optional[int] = None,
-                           capacity_bytes: Optional[float] = None) -> Dict[str, Any]:
+                           capacity_bytes: Optional[float] = None,
+                           paged_pool: Optional[Mapping[str, Any]] = None,
+                           ) -> Dict[str, Any]:
     """The generation path's ledger: params + the KV cache the cached decode
     loop carries (2 x depth x b x seq x heads x dim_head in the param dtype,
-    models/sampling.init_cache) + the per-position logits buffer."""
+    models/sampling.init_cache) + the per-position logits buffer.
+
+    `paged_pool` ({num_blocks, block_size, num_slots, itemsize?} — see
+    serving/kv_pool.paged_ledger_entry) switches the KV row to the serving
+    engine's shape: the shared block pool at rest plus the transient
+    one-layer dense gather the paged decode step materializes (`batch` then
+    counts decode SLOTS, not a dense request batch)."""
     if itemsize is None:
         itemsize = 4
         if params is not None:
@@ -453,9 +461,23 @@ def sampling_memory_ledger(cfg: Any, batch: int, params: Any = None,
     if params is not None:
         rows.append({"name": "params", "bytes": tree_float_bytes(params),
                      "detail": "storage dtypes"})
-    kv = 2.0 * cfg.depth * batch * cfg.total_seq_len * cfg.heads * cfg.dim_head * itemsize
-    rows.append({"name": "kv_cache", "bytes": kv,
-                 "detail": f"2 x depth x b{batch} x s{cfg.total_seq_len} x h x dh"})
+    if paged_pool is not None:
+        nb = int(paged_pool["num_blocks"])  # host-sync-ok: static pool geometry
+        bs = int(paged_pool["block_size"])  # host-sync-ok: static pool geometry
+        slots = int(paged_pool.get("num_slots", batch))
+        isz = int(paged_pool.get("itemsize", itemsize))
+        pool_bytes = 2.0 * cfg.depth * nb * cfg.heads * bs * cfg.dim_head * isz
+        rows.append({"name": "paged_kv_pool", "bytes": pool_bytes,
+                     "detail": (f"{nb} blocks x {bs} tok x 2 x depth x h x dh "
+                                "(shared, at rest)")})
+        # the paged decode gathers ONE layer's dense view per slot at a time
+        gather = 2.0 * slots * cfg.heads * cfg.total_seq_len * cfg.dim_head * isz
+        rows.append({"name": "paged_gather", "bytes": gather,
+                     "detail": f"one layer's dense view x {slots} slots (transient)"})
+    else:
+        kv = 2.0 * cfg.depth * batch * cfg.total_seq_len * cfg.heads * cfg.dim_head * itemsize
+        rows.append({"name": "kv_cache", "bytes": kv,
+                     "detail": f"2 x depth x b{batch} x s{cfg.total_seq_len} x h x dh"})
     rows.append({"name": "logits", "bytes": 1.0 * batch * cfg.total_tokens * 4,
                  "detail": "per-position vocab logits (f32)"})
     return _finish_ledger(rows, batch=batch, capacity_bytes=capacity_bytes)
@@ -722,6 +744,13 @@ def oom_suggestions(ledger: Optional[Mapping[str, Any]],
         out.append("shrink the generation --batch_size (the KV cache is linear "
                    "in it)")
         out.append("cast params (and so the cache) to bfloat16 for sampling")
+    if dominant == "paged_kv_pool":
+        out.append("shrink the serving pool (--num_blocks) or --block_size — "
+                   "admission control will queue instead")
+        out.append("cast params (and so the pool) to bfloat16 for serving")
+    if dominant == "paged_gather":
+        out.append("shrink --slots (the transient gather is linear in decode "
+                   "slots)")
     out.append("shrink --batch_size (or shard it further with --mesh_dp/--mesh_fsdp)")
     return out
 

@@ -1,0 +1,26 @@
+"""Production generation service: continuous batching over a paged KV pool.
+
+`kv_pool` owns the shared block pool (device arrays + host free list),
+`scheduler` owns the host-side request queue and admission control, and
+`engine` runs the jitted prefill/decode lifecycle that turns admitted
+prompts into images.  `cli/serve.py` is the long-lived entry point and
+`tools/loadgen.py` drives it with Poisson traffic.
+"""
+from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+from dalle_pytorch_tpu.serving.kv_pool import BlockPool
+from dalle_pytorch_tpu.serving.scheduler import (
+    AdmissionController,
+    AdmissionRefused,
+    Request,
+    RequestQueue,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRefused",
+    "BlockPool",
+    "EngineConfig",
+    "GenerationEngine",
+    "Request",
+    "RequestQueue",
+]

@@ -1,0 +1,577 @@
+"""The continuous-batching generation engine.
+
+A long-lived service loop over the `_prefill_phase`/`_decode_phase` seam:
+each `poll()` iteration (1) admits queued prompts into free decode slots —
+prefill runs per admission through the EXISTING `_prefill_phase` (identical
+math to the fused sampler) and its dense cache is scattered into the shared
+paged block pool; (2) runs ONE fused `paged_decode_step` for every active
+slot — sequences at arbitrary positions advance together under one static
+shape, so admissions and evictions never recompile; (3) evicts finished
+sequences, frees their blocks, and decodes their codes through the VAE.
+
+RNG is per-request: each request's key is split exactly the way
+`sample_image_codes` splits a batch-1 call's key, so engine output is
+BIT-IDENTICAL to the fused sampler for the same prompt + key
+(tests/test_serving.py proves it, greedy and stochastic, guided and not).
+
+Classifier-free guidance: a guided request occupies TWO lanes — its [cond]
+and [null] sequences have different KV — and the per-lane `partner`/
+`feed_src` index vectors implement `_cfg_combine` and the shared feed token
+inside the one fused step.
+
+Host work here is deliberate and synchronizes only at admission (TTFT needs
+the first token to exist) and eviction (pulling a finished slot's codes);
+the steady-state decode loop dispatches asynchronously.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models import sampling as sampling_mod
+from dalle_pytorch_tpu.models.transformer import (
+    init_slot_rings,
+    paged_decode_step,
+    write_prefill_to_pool,
+)
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.ops.sampling import gumbel_sample, top_k_filter
+from dalle_pytorch_tpu.ops.stable import divide_max
+from dalle_pytorch_tpu.serving.kv_pool import BlockPool
+from dalle_pytorch_tpu.serving.scheduler import (
+    AdmissionController,
+    AdmissionRefused,
+    Request,
+    RequestQueue,
+)
+from dalle_pytorch_tpu.training import resilience
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving knobs.  `num_blocks` defaults to exactly enough for
+    `num_slots` full sequences (no refusals from the pool until slots run
+    out); size it SMALLER to make the pool the admission bottleneck."""
+
+    num_slots: int = 4
+    block_size: int = 32
+    num_blocks: Optional[int] = None
+    max_queue: int = 64
+    headroom_frac: float = 0.92
+    filter_thres: float = 0.9
+    telemetry_every: int = 32  # poll iterations between serving_window events
+
+
+class GenerationEngine:
+    def __init__(
+        self,
+        params: dict,
+        cfg,
+        vae_params: Optional[dict] = None,
+        vae_cfg: Any = None,
+        engine_cfg: EngineConfig = EngineConfig(),
+        usage_fn=None,
+    ):
+        assert cfg.image_seq_len >= 2, "engine needs at least 2 image tokens"
+        self.params = params
+        self.cfg = cfg
+        self.tcfg = cfg.transformer_config()
+        self.vae_params = vae_params
+        self.vae_cfg = vae_cfg
+        self.ecfg = engine_cfg
+        self.n_pre = cfg.text_seq_len + 1  # bos + text (prime_len 0)
+        self.n_gen = cfg.image_seq_len
+
+        ldtype = params["logits_linear"]["w"].dtype  # the init_cache convention
+        self.pool = BlockPool(
+            self.tcfg,
+            engine_cfg.num_blocks
+            if engine_cfg.num_blocks is not None
+            else engine_cfg.num_slots * _blocks_per_seq(self.tcfg, engine_cfg.block_size),
+            engine_cfg.block_size,
+            dtype=ldtype,
+        )
+        self.queue = RequestQueue(max_depth=engine_cfg.max_queue)
+        self.admission = AdmissionController(
+            self.pool,
+            headroom_frac=engine_cfg.headroom_frac,
+            usage_fn=usage_fn,
+            on_alarm=self._alarm,
+        )
+
+        S = engine_cfg.num_slots
+        nk = max(self.n_gen - 1, 1)
+        self._state: Dict[str, Any] = {
+            "pool": self.pool.device_pool(ldtype),
+            "rings": init_slot_rings(self.tcfg, S, ldtype),
+            "block_tables": jnp.zeros((S, self.pool.blocks_per_seq), jnp.int32),
+            "offsets": jnp.zeros((S,), jnp.int32),
+            "prev_code": jnp.zeros((S,), jnp.int32),
+            "img_prev": jnp.zeros((S,), jnp.int32),
+            "codes": jnp.zeros((S, self.n_gen), jnp.int32),
+            "keys": jnp.zeros((S, nk, 2), jnp.uint32),
+            "temp": jnp.ones((S,), jnp.float32),
+            "cscale": jnp.ones((S,), jnp.float32),
+            "guided": jnp.zeros((S,), bool),
+            "partner": jnp.arange(S, dtype=jnp.int32),
+            "feed_src": jnp.arange(S, dtype=jnp.int32),
+            "active": jnp.zeros((S,), bool),
+        }
+        self._free_lanes: List[int] = list(range(S))
+        self._inflight: List[Request] = []
+        self._next_id = 0
+        self._iter = 0
+        self._warm_decode = False
+        self._flood_rng = np.random.RandomState(0)
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(self._decode_step_impl, donate_argnums=donate)
+        self._admit_fns: Dict[Any, Any] = {}
+        self._vae_decode = None
+        if vae_params is not None:
+            from dalle_pytorch_tpu.models import vae_registry
+
+            self._vae_decode = jax.jit(
+                lambda codes: vae_registry.decode_indices(vae_params, vae_cfg, codes)
+            )
+
+    # ------------------------------------------------------------------ jits
+    def _decode_step_impl(self, params, state):
+        """One fused decode step for all slots."""
+        cfg, tcfg = self.cfg, self.tcfg
+        S = self.ecfg.num_slots
+        prev = state["prev_code"]
+
+        emb = jnp.take(dalle_mod._image_table(params, cfg), prev[:, None],
+                       axis=0, mode="clip")
+        pos = dalle_mod.image_pos_table(params, cfg)
+        if pos is not None:
+            emb = emb + jnp.take(pos, state["img_prev"], axis=0, mode="clip")[:, None]
+
+        out, pool, rings = paged_decode_step(
+            params["transformer"], tcfg, emb, state["pool"],
+            state["block_tables"], state["offsets"], state["rings"],
+            self.ecfg.block_size,
+        )
+
+        # per-slot _logits_at: row = producing position = pre-increment offset
+        if cfg.stable:
+            out = divide_max(out)
+        logits = dalle_mod.to_logits(params, cfg, out)[:, 0]  # (S, V)
+        rows = jnp.take(
+            dalle_mod.logits_mask_slice(cfg, cfg.total_seq_len),
+            state["offsets"], axis=0, mode="clip",
+        )
+        logits = jnp.where(rows, jnp.finfo(logits.dtype).min, logits)
+
+        # classifier-free guidance across lane pairs (solo lanes pass through)
+        null_lg = jnp.take(logits, state["partner"], axis=0)
+        lg = jnp.where(
+            state["guided"][:, None],
+            null_lg + (logits - null_lg) * state["cscale"][:, None].astype(logits.dtype),
+            logits,
+        )
+        filtered = top_k_filter(lg, thres=self.ecfg.filter_thres)
+        keys_t = jnp.take_along_axis(
+            state["keys"],
+            jnp.clip(state["img_prev"], 0, state["keys"].shape[1] - 1)[:, None, None],
+            axis=1,
+        )[:, 0]
+
+        def sample_one(lg_row, k, t):
+            # (1, V) shapes mirror the fused sampler's batch-1 call exactly
+            return gumbel_sample(k, lg_row[None], temperature=t)[0]
+
+        toks = jax.vmap(sample_one)(filtered, keys_t, state["temp"].astype(logits.dtype))
+        code = jnp.clip(
+            toks - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1
+        ).astype(jnp.int32)
+        code = jnp.take(code, state["feed_src"], axis=0)  # null lanes feed cond's code
+
+        act = state["active"]
+        img_new = jnp.where(act, state["img_prev"] + 1, state["img_prev"])
+        widx = jnp.clip(img_new, 0, self.n_gen - 1)
+        existing = jnp.take_along_axis(state["codes"], widx[:, None], axis=1)[:, 0]
+        codes_buf = state["codes"].at[jnp.arange(S), widx].set(
+            jnp.where(act, code, existing)
+        )
+        return dict(
+            state,
+            pool=pool,
+            rings=rings,
+            offsets=jnp.where(act, state["offsets"] + 1, state["offsets"]),
+            prev_code=jnp.where(act, code, state["prev_code"]),
+            img_prev=img_new,
+            codes=codes_buf,
+        )
+
+    def _admit_fn_for(self, cond_scale: float, lanes: int):
+        key = (float(cond_scale), lanes)  # host-sync-ok: python jit-cache key
+        fn = self._admit_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg, tcfg = self.cfg, self.tcfg
+        guided = cond_scale != 1.0
+
+        def admit(params, state, text, k0, temperature, bt_rows, lane_idx):
+            cache, last_logits = sampling_mod._prefill_phase(
+                params, cfg, text, None, 0, cond_scale
+            )
+            lg = (sampling_mod._cfg_combine(last_logits, cond_scale)
+                  if guided else last_logits)
+            filtered = top_k_filter(lg, thres=self.ecfg.filter_thres)
+            # cast to the logits dtype: the fused path's python-float
+            # temperature is WEAKLY typed (bf16 logits stay bf16 through the
+            # division); a strong f32 scalar would promote and break parity
+            tok = gumbel_sample(k0, filtered,
+                                temperature=temperature.astype(filtered.dtype))
+            code = jnp.clip(
+                tok - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1
+            ).astype(jnp.int32)  # (1,)
+
+            pool = write_prefill_to_pool(
+                tcfg, state["pool"], bt_rows, cache["layers"],
+                self.n_pre, self.ecfg.block_size,
+            )
+            rings = state["rings"]
+            if rings is not None:
+                if tcfg.scan_layers:
+                    rl, cl = rings["layers"], cache["layers"]
+                    rings = {"layers": dict(
+                        rl,
+                        shift_attn=rl["shift_attn"].at[:, lane_idx].set(
+                            cl["shift_attn"].astype(rl["shift_attn"].dtype)),
+                        shift_ff=rl["shift_ff"].at[:, lane_idx].set(
+                            cl["shift_ff"].astype(rl["shift_ff"].dtype)),
+                    )}
+                else:
+                    new_layers = []
+                    for rl, cl in zip(rings["layers"], cache["layers"]):
+                        new_layers.append({
+                            "shift_attn": rl["shift_attn"].at[lane_idx].set(
+                                cl["shift_attn"].astype(rl["shift_attn"].dtype)),
+                            "shift_ff": rl["shift_ff"].at[lane_idx].set(
+                                cl["shift_ff"].astype(rl["shift_ff"].dtype)),
+                        })
+                    rings = {"layers": new_layers}
+
+            codeb = jnp.broadcast_to(code, (lanes,))
+            return dict(
+                state,
+                pool=pool,
+                rings=rings,
+                block_tables=state["block_tables"].at[lane_idx].set(bt_rows),
+                codes=state["codes"].at[lane_idx, 0].set(codeb),
+                prev_code=state["prev_code"].at[lane_idx].set(codeb),
+                offsets=state["offsets"].at[lane_idx].set(self.n_pre),
+                img_prev=state["img_prev"].at[lane_idx].set(0),
+            )
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(admit, donate_argnums=donate)
+        self._admit_fns[key] = fn
+        return fn
+
+    # ------------------------------------------------------------- lifecycle
+    def _make_request(self, text, key, temperature, cond_scale,
+                      synthetic) -> Request:
+        if key is None:
+            key = jax.random.PRNGKey(self._next_id)
+        req = Request(
+            id=self._next_id,
+            text=np.asarray(text, np.int32).reshape(self.cfg.text_seq_len),  # host-sync-ok: host token ids
+            key=np.asarray(key, np.uint32).reshape(2),  # host-sync-ok: host PRNG key
+            temperature=float(temperature),  # host-sync-ok: CLI/host scalar
+            cond_scale=float(cond_scale),  # host-sync-ok: CLI/host scalar
+            synthetic=synthetic,
+        )
+        self._next_id += 1
+        return req
+
+    def submit(self, text, key=None, temperature: float = 1.0,
+               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+        """Enqueue one prompt.  `text`: (text_seq_len,) raw token ids;
+        `key`: request PRNG key (defaults to PRNGKey(request id)).  Raises
+        AdmissionRefused when the service must shed load (queue full, or
+        the request can never fit the pool)."""
+        req = self._make_request(text, key, temperature, cond_scale, synthetic)
+        try:
+            self.admission.screen_submit(req)
+            self.queue.push(req)
+        except AdmissionRefused as e:
+            obs_metrics.counter("serving/refused").inc()
+            self.admission.note_refusal(e.reason)
+            raise
+        obs_metrics.counter("serving/submitted").inc()
+        return req
+
+    def submit_when_able(self, text, key=None, temperature: float = 1.0,
+                         cond_scale: float = 1.0) -> Request:
+        """Blocking submit for batch callers (generate.py --engine, the
+        prompt-mode serve CLI): a full queue BLOCKS — the engine polls until
+        a slot frees — instead of refusing.  Counted as ONE
+        `serving/submit_waits`, not a refusal per retry (those counters
+        measure shed load, which a waiting batch caller is not).  A request
+        that can NEVER fit the pool still refuses outright."""
+        req = self._make_request(text, key, temperature, cond_scale, False)
+        try:
+            self.admission.screen_submit(req)
+        except AdmissionRefused:
+            obs_metrics.counter("serving/refused").inc()
+            raise
+        waited = False
+        while len(self.queue) >= self.queue.max_depth:
+            if not waited:
+                obs_metrics.counter("serving/submit_waits").inc()
+                waited = True
+            self.poll()  # a full queue implies busy, so this makes progress
+        self.queue.push(req)
+        obs_metrics.counter("serving/submitted").inc()
+        return req
+
+    @property
+    def busy(self) -> bool:
+        """Work pending: queued or in-flight requests."""
+        return bool(len(self.queue) or self._inflight)
+
+    def poll(self) -> List[Request]:
+        """One engine iteration: flood-fault poll, admissions, one fused
+        decode step, evictions.  Returns the requests completed this
+        iteration (codes — and images when a VAE is attached — populated)."""
+        self._iter += 1
+        self._poll_flood()
+        self._admit_ready()
+        if self._inflight:
+            self._decode_once()
+        done = self._evict_finished()
+        if self.ecfg.telemetry_every and self._iter % self.ecfg.telemetry_every == 0:
+            self._window_event()
+        return done
+
+    def run_until_idle(self, max_iters: Optional[int] = None) -> List[Request]:
+        """Drive poll() until queue and slots drain; returns all completions."""
+        out: List[Request] = []
+        iters = 0
+        while len(self.queue) or self._inflight:
+            out.extend(self.poll())
+            iters += 1
+            if max_iters is not None and iters >= max_iters:
+                break
+        return out
+
+    def generate(self, texts, keys=None, temperature: float = 1.0,
+                 cond_scale: float = 1.0) -> List[Request]:
+        """Convenience batch API: submit every row of `texts` (b, ts) with
+        its own key (row i of `keys`, default PRNGKey(i)) and run to
+        completion.  Returns requests in submission order."""
+        texts = np.asarray(texts)  # host-sync-ok: caller-provided host prompts
+        reqs = []
+        for i in range(texts.shape[0]):
+            k = keys[i] if keys is not None else jax.random.PRNGKey(i)
+            # blocking submit: a batch larger than the queue cap waits for
+            # slots instead of being refused (shedding is for live traffic)
+            reqs.append(self.submit_when_able(
+                texts[i], key=k, temperature=temperature,
+                cond_scale=cond_scale))
+        self.run_until_idle()
+        return reqs
+
+    # ---------------------------------------------------------------- internals
+    def _suspend_compiles(self):
+        tele = telemetry.active()
+        if tele is not None and tele.compile_watcher is not None:
+            return tele.compile_watcher.suspended()
+        return contextlib.nullcontext()
+
+    def _alarm(self, fields: Dict[str, Any]) -> None:
+        tele = telemetry.active()
+        if tele is not None:
+            f = dict(fields)
+            tele.alarm(f.pop("type", "serving_backpressure"), **f)
+
+    def _poll_flood(self) -> None:
+        n = resilience.take_flood_fault(self._iter)
+        if n:
+            print(f"[chaos] flood: injecting {n} synthetic requests", flush=True)
+            for _ in range(n):
+                text = self._flood_rng.randint(
+                    1, self.cfg.num_text_tokens, size=(self.cfg.text_seq_len,)
+                )
+                try:
+                    self.submit(text, synthetic=True)
+                    obs_metrics.counter("serving/flood_injected").inc()
+                except AdmissionRefused:
+                    pass  # refusal IS the drill's success mode (counted in submit)
+
+    def _admit_ready(self) -> None:
+        while True:
+            req = self.queue.peek()
+            if req is None:
+                return
+            reason = self.admission.may_admit(
+                req, free_lanes=len(self._free_lanes),
+                in_flight=len(self._inflight))
+            if reason is not None:
+                self.admission.note_deferral(reason)
+                return
+            self._do_admit(self.queue.pop())
+            self.admission.note_flow()
+
+    def _do_admit(self, req: Request) -> None:
+        lanes = [self._free_lanes.pop(0) for _ in range(req.lanes_needed)]
+        req.lanes = lanes
+        tables = np.stack([
+            self.pool.alloc_table(owner=(req.id << 1) | i)
+            for i in range(len(lanes))
+        ])
+        # the request's RNG stream, derived exactly as _decode_phase does
+        key, k0 = jax.random.split(jnp.asarray(req.key, jnp.uint32))
+        step_keys = jax.random.split(key, max(self.n_gen - 1, 1))
+
+        text = jnp.asarray(req.text[None], jnp.int32)
+        admit_fn = self._admit_fn_for(req.cond_scale, len(lanes))
+        lane_idx = jnp.asarray(lanes, jnp.int32)
+        with self._suspend_compiles():
+            self._state = admit_fn(
+                self.params, self._state, text, k0,
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(tables, jnp.int32), lane_idx,
+            )
+        # host-owned lane metadata (small per-admission device updates)
+        st = self._state
+        cond = lanes[0]
+        st = dict(
+            st,
+            keys=st["keys"].at[cond].set(step_keys.astype(jnp.uint32)),
+            temp=st["temp"].at[lane_idx].set(req.temperature),
+            cscale=st["cscale"].at[lane_idx].set(req.cond_scale),
+            active=st["active"].at[lane_idx].set(True),
+        )
+        if len(lanes) == 2:
+            null = lanes[1]
+            st = dict(
+                st,
+                guided=st["guided"].at[cond].set(True).at[null].set(False),
+                partner=st["partner"].at[cond].set(null).at[null].set(null),
+                feed_src=st["feed_src"].at[cond].set(cond).at[null].set(cond),
+            )
+        else:
+            st = dict(
+                st,
+                guided=st["guided"].at[cond].set(False),
+                partner=st["partner"].at[cond].set(cond),
+                feed_src=st["feed_src"].at[cond].set(cond),
+            )
+        self._state = st
+        self._inflight.append(req)
+        req.codes_done = 1  # the first image token came out of prefill
+        # TTFT: the first token must actually exist
+        jax.block_until_ready(self._state["prev_code"])  # host-sync-ok: TTFT measurement point
+        now = time.monotonic()
+        req.admitted_t = now
+        req.ttft_s = now - req.arrival_t
+        obs_metrics.counter("serving/admitted").inc()
+        obs_metrics.histogram("serving/ttft_s").observe(req.ttft_s)
+        obs_metrics.gauge("serving/active_lanes").set(
+            self.ecfg.num_slots - len(self._free_lanes))
+        obs_metrics.gauge("serving/pool_occupancy_frac").set(self.pool.occupancy_frac)
+        obs_metrics.gauge("serving/pool_free_blocks").set(self.pool.free_blocks)
+
+    def _decode_once(self) -> None:
+        with (self._suspend_compiles() if not self._warm_decode
+              else contextlib.nullcontext()):
+            self._state = self._decode_fn(self.params, self._state)
+        self._warm_decode = True
+        obs_metrics.counter("serving/decode_steps").inc()
+        obs_metrics.counter("serving/decode_lane_tokens").inc(len(self._inflight))
+        for req in self._inflight:
+            req.codes_done += 1
+
+    def _evict_finished(self) -> List[Request]:
+        done = [r for r in self._inflight if r.codes_done >= self.n_gen]
+        if not done:
+            return done
+        self._inflight = [r for r in self._inflight if r.codes_done < self.n_gen]
+        all_lanes: List[int] = []
+        for req in done:
+            req.codes = np.asarray(self._state["codes"][req.lanes[0]])  # host-sync-ok: pulling the finished slot's codes
+            for i in range(len(req.lanes)):
+                self.pool.free_table((req.id << 1) | i)
+            all_lanes.extend(req.lanes)
+            self._free_lanes.extend(req.lanes)
+            req.latency_s = time.monotonic() - req.arrival_t
+        li = jnp.asarray(all_lanes, jnp.int32)
+        st = self._state
+        self._state = dict(
+            st,
+            active=st["active"].at[li].set(False),
+            block_tables=st["block_tables"].at[li].set(0),
+            offsets=st["offsets"].at[li].set(0),
+            img_prev=st["img_prev"].at[li].set(0),
+        )
+        tele = telemetry.active()
+        for req in done:
+            if self._vae_decode is not None:
+                t0 = time.perf_counter()
+                images = self._vae_decode(req.codes[None])
+                jax.block_until_ready(images)  # host-sync-ok: completion boundary
+                obs_metrics.histogram("gen/vae_decode_s").observe(
+                    time.perf_counter() - t0)
+                req.images = np.asarray(images)  # host-sync-ok: delivering the result
+                req.latency_s = time.monotonic() - req.arrival_t
+            obs_metrics.counter("serving/completed").inc()
+            obs_metrics.histogram("serving/request_s").observe(req.latency_s)
+            if tele is not None:
+                tele.spans.write_event(
+                    "serving_request", request_id=req.id, ttft_s=req.ttft_s,
+                    latency_s=req.latency_s, guided=req.guided,
+                    synthetic=req.synthetic,
+                )
+        obs_metrics.gauge("serving/active_lanes").set(
+            self.ecfg.num_slots - len(self._free_lanes))
+        obs_metrics.gauge("serving/pool_occupancy_frac").set(self.pool.occupancy_frac)
+        obs_metrics.gauge("serving/pool_free_blocks").set(self.pool.free_blocks)
+        return done
+
+    def _window_event(self) -> None:
+        tele = telemetry.active()
+        if tele is None:
+            return
+        tele.spans.write_event(
+            "serving_window", iter=self._iter,
+            queue_depth=len(self.queue),
+            active_lanes=self.ecfg.num_slots - len(self._free_lanes),
+            pool_occupancy_frac=self.pool.occupancy_frac,
+            pool_free_blocks=self.pool.free_blocks,
+        )
+
+    def memory_ledger(self, capacity_bytes: Optional[float] = None):
+        """The serving path's HBM ledger: params + the paged pool + the
+        transient per-layer gather working set (memory.sampling_memory_ledger
+        with the paged rows)."""
+        from dalle_pytorch_tpu.observability import memory as memory_mod
+        from dalle_pytorch_tpu.serving.kv_pool import paged_ledger_entry
+
+        return memory_mod.sampling_memory_ledger(
+            self.cfg, self.ecfg.num_slots, self.params,
+            capacity_bytes=capacity_bytes,
+            paged_pool=paged_ledger_entry(
+                self.cfg, self.pool.num_blocks + 1, self.ecfg.block_size,
+                self.ecfg.num_slots,
+            ),
+        )
+
+
+def _blocks_per_seq(tcfg, block_size: int) -> int:
+    from dalle_pytorch_tpu.models.transformer import paged_blocks_per_seq
+
+    return paged_blocks_per_seq(tcfg, block_size)

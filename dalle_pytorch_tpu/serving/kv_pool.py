@@ -1,0 +1,133 @@
+"""The paged KV block pool: device arrays + host-side free-list.
+
+One preallocated pool (models/transformer.init_paged_pool) is shared by every
+in-flight sequence; this module owns the HOST half — which physical blocks
+are free, which belong to which request, and the occupancy numbers admission
+control and the memory ledger price against.  The device half (gather /
+scatter through block tables) lives in models/transformer's paged ops.
+
+Allocation is whole-sequence at admission: generation length is fixed
+(text + image_seq_len), so a reservation and an allocation are the same
+thing — overcommit with mid-flight preemption is future work (vLLM-style
+swapping), and admission control refusing up front is what turns "pool
+exhausted" into backpressure instead of an OOM.
+
+Block 0 is the TRASH block: inactive engine slots keep all-zero block
+tables, so their masked decode lanes scatter into block 0 and can only
+clobber garbage.  It is never handed out.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.models.transformer import (
+    TransformerConfig,
+    init_paged_pool,
+    paged_blocks_per_seq,
+)
+
+
+class PoolExhausted(RuntimeError):
+    """No free blocks for a whole-sequence allocation."""
+
+
+@dataclasses.dataclass
+class BlockPool:
+    """Host free-list over the shared device block pool.
+
+    `num_blocks` counts usable blocks (the trash block is allocated on top),
+    `block_size` is tokens per block.  `device_pool()` materializes the
+    device arrays once; the engine threads them through its jits and keeps
+    the latest version (this object never holds traced values).
+    """
+
+    cfg: TransformerConfig
+    num_blocks: int
+    block_size: int
+    dtype: Any = None
+
+    def __post_init__(self):
+        assert self.block_size > 0 and self.num_blocks > 0
+        self.blocks_per_seq = paged_blocks_per_seq(self.cfg, self.block_size)
+        # physical ids 1..num_blocks; 0 is the trash block
+        self._free: List[int] = list(range(1, self.num_blocks + 1))
+        self._owned: Dict[int, List[int]] = {}
+
+    # -- device side --------------------------------------------------------
+    def device_pool(self, dtype=None) -> dict:
+        """Fresh device arrays for this pool geometry (+1 for the trash
+        block).  Called once at engine construction."""
+        import jax.numpy as jnp
+
+        dt = dtype if dtype is not None else (self.dtype or jnp.float32)
+        return init_paged_pool(self.cfg, self.num_blocks + 1, self.block_size, dt)
+
+    def bytes(self, itemsize: int = 4) -> float:
+        """At-rest bytes of the device pool (k + v, every layer)."""
+        return (
+            2.0 * self.cfg.depth * (self.num_blocks + 1) * self.cfg.heads
+            * self.block_size * self.cfg.dim_head * itemsize
+        )
+
+    # -- host free list -----------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def occupancy_frac(self) -> float:
+        return self.used_blocks / self.num_blocks
+
+    def can_admit(self) -> bool:
+        return len(self._free) >= self.blocks_per_seq
+
+    def fits_ever(self) -> bool:
+        """Could a request EVER be admitted (even on an idle pool)?  False
+        means submit() must refuse outright instead of queueing forever."""
+        return self.num_blocks >= self.blocks_per_seq
+
+    def alloc_table(self, owner: int) -> np.ndarray:
+        """Allocate a full sequence's blocks for request `owner`.  Returns
+        the (blocks_per_seq,) int32 block table; raises PoolExhausted when
+        the pool cannot cover it (admission control's job to pre-check)."""
+        if len(self._free) < self.blocks_per_seq:
+            raise PoolExhausted(
+                f"need {self.blocks_per_seq} blocks, {len(self._free)} free"
+            )
+        blocks = [self._free.pop() for _ in range(self.blocks_per_seq)]
+        self._owned[owner] = blocks
+        return np.asarray(blocks, np.int32)  # host-sync-ok: host free-list ids
+
+    def free_table(self, owner: int) -> None:
+        """Return a request's blocks to the free list (eviction)."""
+        blocks = self._owned.pop(owner, None)
+        if blocks:
+            self._free.extend(blocks)
+
+    def owners(self) -> List[int]:
+        return list(self._owned)
+
+
+def paged_ledger_entry(cfg_geom: Any, num_blocks: int, block_size: int,
+                       num_slots: int, itemsize: Optional[int] = None,
+                       ) -> Optional[Dict[str, Any]]:
+    """The dict `observability.memory.sampling_memory_ledger` prices its
+    paged-pool rows from (geometry comes from the DALLEConfig).  Leave
+    `itemsize` None unless the pool dtype differs from the params' — the
+    ledger's params-derived itemsize is the default, so a bf16 pool is not
+    silently priced at 4 bytes."""
+    entry = {
+        "num_blocks": num_blocks,
+        "block_size": block_size,
+        "num_slots": num_slots,
+    }
+    if itemsize is not None:
+        entry["itemsize"] = itemsize
+    return entry

@@ -1,0 +1,206 @@
+"""Host-side request scheduling and admission control for the serving engine.
+
+Pure host logic (no jax imports at module scope beyond typing): a FIFO
+request queue with a hard depth cap, and an `AdmissionController` that
+decides per engine iteration whether the next queued request may enter a
+decode slot.  Three gates, in order:
+
+  1. **lanes** — a free engine slot (two for a guided request: its [cond]
+     and [null] lanes are separate sequences with separate KV).
+  2. **pool** — enough free blocks for the FULL sequence (kv_pool
+     reservation-at-admission semantics: refusal up front is what turns
+     pool exhaustion into backpressure instead of an OOM).
+  3. **HBM headroom** — the live allocator usage fraction (PR 5's
+     HbmMonitor capacity basis) must sit below `headroom_frac`; above it
+     the controller defers admissions until the allocator recedes.
+
+`submit` refuses (AdmissionRefused) rather than queues when the request can
+NEVER be admitted (pool smaller than one sequence) or the queue is at its
+cap — the flood-fault drill (`--inject_fault flood@STEP`) asserts exactly
+this degradation mode.  Every refusal/deferral is counted in the metrics
+registry and surfaces as a `serving_backpressure` alarm (once per episode,
+re-armed when the queue drains) through the telemetry alarm hub.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+
+
+class AdmissionRefused(RuntimeError):
+    """The service refused a request outright (queue full / can never fit)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  `text`: (text_seq_len,) raw token ids;
+    `key`: the request's PRNG key (raw uint32 (2,)) — the engine derives the
+    exact key stream `sample_image_codes` would, so a request is bit-
+    reproducible against the fused sampler."""
+
+    id: int
+    text: np.ndarray
+    key: np.ndarray
+    temperature: float = 1.0
+    cond_scale: float = 1.0
+    arrival_t: float = dataclasses.field(default_factory=time.monotonic)
+    # runtime (engine-owned)
+    lanes: Optional[List[int]] = None
+    codes_done: int = 0
+    admitted_t: Optional[float] = None
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    synthetic: bool = False
+    # results
+    codes: Optional[np.ndarray] = None
+    images: Optional[np.ndarray] = None
+
+    @property
+    def guided(self) -> bool:
+        return self.cond_scale != 1.0
+
+    @property
+    def lanes_needed(self) -> int:
+        return 2 if self.guided else 1
+
+
+class RequestQueue:
+    """Bounded FIFO.  `push` raises AdmissionRefused at the cap — the
+    caller (engine.submit) converts that into a refused-request metric."""
+
+    def __init__(self, max_depth: int = 64):
+        self.max_depth = max_depth
+        self._q: Deque[Request] = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, req: Request) -> None:
+        if len(self._q) >= self.max_depth:
+            raise AdmissionRefused(
+                f"queue full ({self.max_depth} requests waiting)"
+            )
+        self._q.append(req)
+        obs_metrics.gauge("serving/queue_depth").set(len(self._q))
+
+    def peek(self) -> Optional[Request]:
+        return self._q[0] if self._q else None
+
+    def pop(self) -> Request:
+        req = self._q.popleft()
+        obs_metrics.gauge("serving/queue_depth").set(len(self._q))
+        return req
+
+
+class AdmissionController:
+    """Decides whether the head-of-queue request may be admitted now.
+
+    `usage_fn` returns the live HBM usage fraction (None where the backend
+    exposes no allocator stats — CPU tests inject a fake).  `on_alarm` is
+    the telemetry hub sink for `serving_backpressure` (fired once per
+    episode: the first deferral/refusal after a period of free flow)."""
+
+    def __init__(
+        self,
+        pool,
+        *,
+        headroom_frac: float = 0.92,
+        usage_fn: Optional[Callable[[], Optional[float]]] = None,
+        on_alarm: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ):
+        self.pool = pool
+        self.headroom_frac = headroom_frac
+        self.usage_fn = usage_fn if usage_fn is not None else _default_usage_fn
+        self.on_alarm = on_alarm
+        self._alarmed = False
+
+    def screen_submit(self, req: Request) -> None:
+        """Refuse a request that can NEVER be admitted (satisfying it would
+        require more pool than exists) — queueing it would hang the client."""
+        if not self.pool.fits_ever() or (
+            req.lanes_needed * self.pool.blocks_per_seq > self.pool.num_blocks
+        ):
+            raise AdmissionRefused(
+                f"request needs {req.lanes_needed} x {self.pool.blocks_per_seq} "
+                f"blocks but the pool only has {self.pool.num_blocks} — "
+                "grow --num_blocks or shrink --block_size"
+            )
+
+    def may_admit(self, req: Request, free_lanes: int,
+                  in_flight: int = 0) -> Optional[str]:
+        """None when the request may enter now, else the deferral reason.
+        The headroom gate only applies while something is IN FLIGHT: with
+        zero active lanes the engine's footprint is already at its floor,
+        so deferring can never lower usage — it would just livelock the
+        service (the override is counted, and external memory pressure
+        still shows up through the HbmMonitor alarm)."""
+        if free_lanes < req.lanes_needed:
+            return f"no free slot ({free_lanes} free, {req.lanes_needed} needed)"
+        if self.pool.free_blocks < req.lanes_needed * self.pool.blocks_per_seq:
+            return (
+                f"pool exhausted ({self.pool.free_blocks} blocks free, "
+                f"{req.lanes_needed * self.pool.blocks_per_seq} needed)"
+            )
+        usage = None
+        try:
+            usage = self.usage_fn()
+        except Exception:  # allocator stats must never kill the service
+            usage = None
+        if usage is not None and usage >= self.headroom_frac:
+            if in_flight > 0:
+                return (f"HBM headroom ({usage:.2f} >= "
+                        f"{self.headroom_frac:.2f} usage fraction)")
+            obs_metrics.counter("serving/headroom_overrides").inc()
+        return None
+
+    def _alarm_once(self, reason: str) -> None:
+        if not self._alarmed:
+            self._alarmed = True
+            obs_metrics.counter("serving_backpressure_alarms").inc()
+            if self.on_alarm is not None:
+                self.on_alarm({"type": "serving_backpressure", "reason": reason})
+
+    def note_deferral(self, reason: str) -> None:
+        """A queued request waited this iteration (it will still be served)."""
+        obs_metrics.counter("serving/admission_deferrals").inc()
+        self._alarm_once(reason)
+
+    def note_refusal(self, reason: str) -> None:
+        """A request was shed outright — alarm, but do NOT count a deferral
+        (deferrals measure waiting, refusals measure dropped load; one event
+        must not inflate both)."""
+        self._alarm_once(reason)
+
+    def note_flow(self) -> None:
+        """An admission went through — the backpressure episode (if any)
+        is over and the next deferral alarms again."""
+        self._alarmed = False
+
+
+def _default_usage_fn() -> Optional[float]:
+    """Live allocator usage fraction from the PR 5 memory stack: the
+    max-across-devices bytes_in_use over the device capacity.  None on
+    backends without allocator stats (CPU)."""
+    from dalle_pytorch_tpu.observability.memory import device_hbm_capacity
+    from dalle_pytorch_tpu.observability.xla import record_memory_gauges
+
+    try:
+        stats = record_memory_gauges()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    cap = device_hbm_capacity()
+    if not cap:
+        return None
+    return stats["bytes_in_use"] / cap

@@ -703,6 +703,12 @@ FAULT_KINDS = (
     #                       --resume auto and the elastic resume reshards
     #                       (tools/chaos.py `elastic` drives the full loop)
     "grow",               # same drill, relaunched on MORE devices
+    "flood",              # serving drill: burst of synthetic requests into
+    #                       the generation engine's queue at iteration N
+    #                       (`flood@STEP:COUNT`, default 32) — admission
+    #                       control must degrade to queueing/refusals, not
+    #                       OOM.  No-op under the training CLIs (the engine
+    #                       polls take_flood_fault; at_step ignores it).
 )
 
 
@@ -715,13 +721,14 @@ class Fault:
 
 def parse_fault(spec: str) -> Fault:
     """`KIND@STEP` (e.g. `kill-process@40`); STEP defaults to 0.  stall-data
-    accepts `stall-data@STEP:SECONDS`."""
+    accepts `stall-data@STEP:SECONDS`; flood accepts `flood@STEP:COUNT`
+    (burst size, stored in the same numeric slot)."""
     kind, _, at = spec.partition("@")
     if kind not in FAULT_KINDS:
         raise ValueError(
             f"unknown fault kind {kind!r}; choose from {', '.join(FAULT_KINDS)}"
         )
-    stall_s = 5.0
+    stall_s = 32.0 if kind == "flood" else 5.0
     if ":" in at:
         at, _, secs = at.partition(":")
         stall_s = float(secs)  # host-sync-ok: parsing a CLI flag string
@@ -802,6 +809,21 @@ class FaultInjector:
         else:
             print(f"[chaos] truncating checkpoint {path}", flush=True)
             truncate_file(path)
+
+
+def take_flood_fault(step: int) -> int:
+    """Burst size (0 = none) exactly once when a `flood` fault is armed and
+    the serving engine's iteration counter reaches the fault step — the
+    engine injects that many synthetic requests so chaos drills can verify
+    the service queues/refuses instead of OOMing."""
+    inj = _ACTIVE_INJECTOR
+    if (inj is not None and not inj.fired and inj.fault.kind == "flood"
+            and step >= inj.fault.step):
+        inj.fired = True
+        # parse_fault already defaulted a missing :COUNT to 32; an explicit
+        # flood@STEP:0 is a deliberate no-burst control and stays 0
+        return int(inj.fault.stall_s)  # host-sync-ok: parsed CLI number
+    return 0
 
 
 def take_stream_fault() -> bool:

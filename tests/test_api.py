@@ -37,6 +37,41 @@ def test_reference_readme_usage_dalle():
     assert toks.shape == (1, 8) and texts is None
 
 
+def test_generate_images_exec_cache():
+    """The AOT executable cache (ISSUE 8 satellite): first call compiles
+    (miss), repeats hit, outputs bit-match the plain jitted path, and a new
+    (batch, cond_scale, prime_len) key misses again."""
+    from dalle_pytorch_tpu.observability import metrics as obs_metrics
+
+    vae = DiscreteVAE(image_size=16, num_layers=2, num_tokens=32, codebook_dim=16, hidden_dim=16)
+    dalle = DALLE(dim=32, vae=vae, num_text_tokens=64, text_seq_len=8, depth=1,
+                  heads=2, dim_head=8)
+    text = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 1, 64)
+
+    hits = obs_metrics.counter("gen/exec_cache_hits")
+    misses = obs_metrics.counter("gen/exec_cache_misses")
+    fallbacks = obs_metrics.counter("gen/exec_cache_fallbacks")
+    h0, m0, f0 = hits.value, misses.value, fallbacks.value
+
+    a = dalle.generate_images(text, key=3)
+    assert misses.value == m0 + 1 and hits.value == h0
+    b = dalle.generate_images(text, key=3)
+    assert misses.value == m0 + 1 and hits.value == h0 + 1
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    plain = dalle.generate_images(text, key=3, use_exec_cache=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(plain))
+
+    # a different cond_scale is a different executable
+    dalle.generate_images(text, key=3, cond_scale=2.0)
+    assert misses.value == m0 + 2
+    # temperature and key are DYNAMIC: no new executable
+    dalle.generate_images(text, key=5, temperature=0.5)
+    assert misses.value == m0 + 2 and hits.value == h0 + 2
+    assert fallbacks.value == f0
+    assert len(dalle._exec_cache.entries()) == 2
+
+
 def test_reference_readme_usage_clip():
     clip = CLIP(
         dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=64,

@@ -246,6 +246,26 @@ def test_generate_cli(workspace, trained_dalle):
         assert img.size == (16, 16)
 
 
+def test_generate_cli_engine(workspace, trained_dalle):
+    """--engine routes the same checkpoint through the continuous-batching
+    serving engine (ISSUE 8 satellite): per-image requests, same output
+    surface (PNGs per prompt dir), VAE decode included."""
+    paths = generate_cli.main([
+        "--dalle_path", str(trained_dalle),
+        "--text", "a red circle",
+        "--num_images", "2",
+        "--batch_size", "2",
+        "--engine",
+        "--engine_slots", "2",
+        "--engine_block_size", "8",
+        "--outputs_dir", str(workspace / "outputs_engine"),
+    ])
+    assert len(paths) == 2
+    for p in paths:
+        img = Image.open(p)
+        assert img.size == (16, 16)
+
+
 def test_generate_cli_gentxt(workspace, trained_dalle):
     paths = generate_cli.main([
         "--dalle_path", str(trained_dalle),

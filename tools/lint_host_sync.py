@@ -64,6 +64,11 @@ JIT_PURE = (
     # casts are waived line-by-line
     "dalle_pytorch_tpu/parallel/registry.py",
     "dalle_pytorch_tpu/parallel/reshard.py",
+    # the serving engine's jitted admit/decode bodies must stay sync-free
+    # (one stray sync there stalls EVERY in-flight request each step); the
+    # scheduler's deliberate host work — TTFT blocking, pulling finished
+    # codes, CLI scalars — is waived line-by-line
+    "dalle_pytorch_tpu/serving",
 )
 
 WAIVER = "host-sync-ok"

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Render a serving run's SLO story from its telemetry spans JSONL.
+
+    python tools/serving_report.py /tmp/tele/serve.spans.jsonl
+    python tools/serving_report.py /tmp/tele           # picks *.spans.jsonl
+
+Three sections, all from the stream serving/engine.py writes:
+
+* **requests** (`kind:"serving_request"`) — completion count, exact p50/p99
+  time-to-first-token and request latency, guided/synthetic split, and
+  throughput over the record span;
+* **engine windows** (`kind:"serving_window"`) — queue depth, active lanes,
+  and paged-pool occupancy over time (the saturation timeline);
+* **backpressure** — `serving_backpressure` alarms plus the refusal /
+  deferral counters from metric snapshots.
+
+Pure stdlib; works on a partially-written file from a live run."""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from telemetry_report import load_records  # noqa: E402 — same torn-line tolerance
+
+
+def _pct(vals: List[float], q: float):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    idx = min(int(round(q * (len(vals) - 1))), len(vals) - 1)
+    return vals[idx]
+
+
+def _ms(v) -> str:
+    return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+
+def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
+    reqs = [r for r in records if r.get("kind") == "serving_request"]
+    windows = [r for r in records if r.get("kind") == "serving_window"]
+    alarms = [r for r in records if r.get("kind") == "alarm"
+              and r.get("type") == "serving_backpressure"]
+
+    out: List[str] = []
+    if reqs:
+        ttfts = [r["ttft_s"] for r in reqs if r.get("ttft_s") is not None]
+        lats = [r["latency_s"] for r in reqs if r.get("latency_s") is not None]
+        guided = sum(1 for r in reqs if r.get("guided"))
+        synth = sum(1 for r in reqs if r.get("synthetic"))
+        span_s = None
+        ts = [r.get("ts") for r in reqs if r.get("ts") is not None]
+        if len(ts) >= 2:
+            span_s = max(ts) - min(ts)
+        out.append(f"requests: {len(reqs)} completed "
+                   f"({guided} guided, {synth} synthetic)")
+        out.append(f"  TTFT     p50 {_ms(_pct(ttfts, 0.50))}   "
+                   f"p99 {_ms(_pct(ttfts, 0.99))}")
+        out.append(f"  latency  p50 {_ms(_pct(lats, 0.50))}   "
+                   f"p99 {_ms(_pct(lats, 0.99))}")
+        if span_s and span_s > 0:
+            out.append(f"  throughput over record span: "
+                       f"{len(reqs) / span_s:.3f} images/sec/chip")
+    else:
+        out.append("no serving_request records — did the run route through "
+                   "the engine with telemetry active?")
+
+    if windows:
+        out.append("")
+        out.append(f"engine windows ({len(windows)}; last {max_rows}):")
+        out.append("  iter     queue  lanes  pool_occ  free_blocks")
+        for w in windows[-max_rows:]:
+            out.append(
+                f"  {w.get('iter', '-'):>6} {w.get('queue_depth', 0):>6} "
+                f"{w.get('active_lanes', 0):>6} "
+                f"{(w.get('pool_occupancy_frac') or 0) * 100:>7.1f}% "
+                f"{w.get('pool_free_blocks', '-'):>10}")
+
+    out.append("")
+    if alarms:
+        out.append(f"backpressure alarms: {len(alarms)}")
+        for a in alarms[-5:]:
+            out.append(f"  {a.get('reason', '')}")
+    else:
+        out.append("backpressure alarms: none")
+
+    counters = {}
+    for r in records:
+        if r.get("kind") != "metrics":
+            continue
+        for name in ("serving/submitted", "serving/admitted", "serving/refused",
+                     "serving/admission_deferrals", "serving/completed",
+                     "serving/flood_injected"):
+            rec = (r.get("metrics") or {}).get(name)
+            if rec and rec.get("total") is not None:
+                counters[name] = rec["total"]
+    if counters:
+        out.append("")
+        out.append("counters (final snapshot):")
+        for name, v in counters.items():
+            out.append(f"  {name:<30} {v:>10.0f}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="spans JSONL file or telemetry dir")
+    parser.add_argument("--max_rows", type=int, default=20)
+    args = parser.parse_args(argv)
+
+    p = Path(args.path)
+    if p.is_dir():
+        candidates = sorted(p.glob("*.spans.jsonl"))
+        if not candidates:
+            print(f"no *.spans.jsonl under {p}")
+            return 1
+        p = candidates[-1]
+    print(build_report(load_records(p), max_rows=args.max_rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
