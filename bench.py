@@ -99,6 +99,66 @@ def _reexec_cpu_degraded() -> None:
     sys.exit(proc.returncode)
 
 
+def _sparse_attention_row(on_tpu: bool) -> dict:
+    """Dense vs compacted flash-attention grid, per sparse pattern, at the
+    train sequence (1280) and the long-context scenario (4096, 64x64 fmaps).
+
+    The static live-tile counts ARE the speedup model — each live tile costs
+    the same MXU work, so step time should scale with the live fraction; on
+    TPU both grids are timed to validate that, on CPU (interpret mode, where
+    kernel timings are meaningless) the row reports the counts alone."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.kernels import sparse_index as si
+    from dalle_pytorch_tpu.kernels.flash_attention import (
+        flash_attention, resolve_block,
+    )
+    from dalle_pytorch_tpu.models.transformer import TransformerConfig, _pattern_for
+    from dalle_pytorch_tpu.ops.masks import block_live_np
+
+    out = {}
+    # 1280 runs the production 256x256 tiles — at the train sequence the
+    # pattern bands (257 text cols + a 32-token image row) are wider than a
+    # tile, so the ratio is ~1 and the row is a no-regression check; the
+    # payoff case is 4096 at 128x128 tiles (at 256 a query block spans 4+1
+    # image rows and the axial_row ratio sags to ~3x)
+    for n, fmap, blk in ((1280, 32, 256), (4096, 64, 128)):
+        bq = resolve_block(n, blk)
+        nq = n // bq
+        dense_tiles = int(si.block_causal_live_np(nq, nq, bq, bq).sum())
+        pcfg = TransformerConfig(dim=256, depth=1, seq_len=n, heads=4,
+                                 dim_head=64, image_fmap_size=fmap)
+        if on_tpu:
+            ks = jax.random.split(jax.random.PRNGKey(0), 3)
+            q, k, v = (jax.random.normal(kk, (1, 4, n, 64), jnp.float32)
+                       for kk in ks)
+        per = {"dense_tiles": dense_tiles, "block": bq}
+        for pat in ("axial_row", "axial_col", "conv_like", "sparse"):
+            mask = np.asarray(_pattern_for(pcfg, pat), bool)
+            tabs = si.build_compacted_tables(
+                block_live_np(mask, bq, bq), bq, bq)
+            live_fwd, _ = si.live_tile_counts(tabs)
+            entry = {"live_tiles": live_fwd,
+                     "tile_ratio": round(dense_tiles / max(live_fwd, 1), 2)}
+            if on_tpu:
+                jm = jnp.asarray(mask)
+                for grid in ("dense", "compact"):
+                    f = jax.jit(lambda q, k, v, g=grid: flash_attention(
+                        q, k, v, mask=jm, block_q=bq, block_k=bq, grid=g))
+                    f(q, k, v).block_until_ready()
+                    t0 = time.perf_counter()
+                    for _ in range(10):
+                        o = f(q, k, v)
+                    o.block_until_ready()
+                    entry[f"{grid}_ms"] = round(
+                        (time.perf_counter() - t0) / 10 * 1e3, 3)
+                entry["speedup"] = round(
+                    entry["dense_ms"] / max(entry["compact_ms"], 1e-9), 2)
+            per[pat] = entry
+        out[f"seq{n}"] = per
+    return out
+
+
 def _arm_init_watchdog(timeout_s: int = 300):
     """Last-ditch escape for the probe-passed-then-tunnel-died window: if the
     parent's own backend init blocks in the PJRT retry loop (the rc=124
@@ -232,7 +292,10 @@ def main():
 
     step_time = dt / steps
     img_tok_per_sec = batch * cfg.image_seq_len / step_time
-    flops = dalle_step_flops(cfg, batch, n_matmul)
+    # tile granularity: MFU against the FLOPs the kernels actually execute
+    # (whole live tiles), not the element-granular algorithmic density —
+    # sparse configs otherwise read as having more headroom than they do
+    flops = dalle_step_flops(cfg, batch, n_matmul, granularity="tile")
     mfu = flops / step_time / _chip_peak()
 
     # span breakdown beside the MFU number: a SEPARATE short synced pass
@@ -410,6 +473,13 @@ def main():
         "live_peak_mb": (round(live["peak_bytes_in_use"] / 1e6, 2)
                          if live and "peak_bytes_in_use" in live else None),
     }
+
+    # sparse-attention row (ISSUE 10): dense vs compacted grid per pattern
+    # at seq 1280 and the 4096 long-context scenario
+    try:
+        sparse_attention_row = _sparse_attention_row(on_tpu)
+    except Exception as e:
+        sparse_attention_row = {"error": repr(e)[:200]}
 
     # generation wall-clock (BASELINE.md row 3): KV-cached sampling, same
     # model; plus the FULL generate-images pipeline (codes -> VAE decode ->
@@ -621,6 +691,7 @@ def main():
         "async_checkpoint": async_checkpoint_row,
         "memory": memory_row,
         "serving": serving_row,
+        "sparse_attention": sparse_attention_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
             round(gen_full_s_per_image, 3) if gen_full_s_per_image else None

@@ -1303,9 +1303,14 @@ def main(argv=None):
                                 dalle_step_flops, matmul_param_count,
                             )
 
+                            # tile granularity: the compiled step's cost
+                            # analysis includes the kernels' tile-granular
+                            # CostEstimate, so the analytic side must price
+                            # whole live tiles or sparse configs drift
                             analytic = dalle_step_flops(
                                 dalle_cfg, int(device_batch["text"].shape[0]),
                                 matmul_param_count(state.params),
+                                granularity="tile",
                             )
                             # comms ledger: analytic bytes/step per mesh axis
                             # from the mesh + sharding settings, published as

@@ -61,6 +61,14 @@ class DALLEConfig:
     sparse_block_size: int = 16
     sparse_per_head: bool = False  # per-head random block layouts (DeepSpeed parity)
     attn_kernel: str = "auto"  # 'auto' | 'flash' | 'xla'
+    # flash-kernel grid: 'auto' compacts when the pattern kills tiles inside
+    # the causal triangle; 'dense' | 'compact' force (TransformerConfig docs)
+    attn_grid: str = "auto"
+    attn_vfa: bool = False  # VFA global-max forward pass (allclose, not bitwise)
+    # cached/paged decode gathers only pattern-permitted keys (Kmax reads per
+    # step instead of the full cache).  Off: full-cache reads — bit-stable vs
+    # pre-sparse-decode sampling (the gather is reduction-order-ulp close)
+    sparse_decode: bool = True
     seq_shard_axis: Optional[str] = None  # sequence-parallel mesh axis (e.g. 'sp')
     pipeline_axis: Optional[str] = None  # pipeline-parallel mesh axis (e.g. 'pp')
     pp_interleave: int = 1  # circular pipeline chunks per device (bubble / v)
@@ -115,6 +123,9 @@ class DALLEConfig:
             sparse_block_size=self.sparse_block_size,
             sparse_per_head=self.sparse_per_head,
             attn_kernel=self.attn_kernel,
+            attn_grid=self.attn_grid,
+            attn_vfa=self.attn_vfa,
+            sparse_decode=self.sparse_decode,
             seq_shard_axis=self.seq_shard_axis,
             pipeline_axis=self.pipeline_axis,
             pp_num_micro=self.pp_num_micro,

@@ -11,6 +11,15 @@ twice the MXU utilization.
 
 Image priming takes a static primer length (static shapes are what XLA
 compiles); the reference's 0.4375 * image_seq_len default is preserved.
+
+With sparse attention patterns the decode loop is sparse-aware by default
+(DALLEConfig.sparse_decode): each step gathers only the pattern-permitted
+keys from the KV cache (kernels/sparse_index.build_decode_tables) instead
+of reading and row-masking the whole prefix — the difference between O(seq)
+and O(Kmax) cache reads per token, which is what makes image_fmap_size=64
+(seq 4096+) sampling tractable.  The gathered softmax is reduction-order-ulp
+close (not bit-identical) to the full-cache read; parity-RNG comparisons
+against pre-gather implementations should pin sparse_decode=False.
 """
 from __future__ import annotations
 

@@ -109,6 +109,20 @@ class TransformerConfig:
     # distinct layout, so this is opt-in; unsupported with scan_layers (the
     # scan stacks masks for EVERY layer — x heads would multiply that).
     sparse_per_head: bool = False
+    # flash-kernel grid selection, forwarded to kernels.flash_attention:
+    # 'auto' runs the compacted (live-tiles-only, scalar-prefetch) grid when a
+    # layer's pattern actually kills tiles, the dense pl.when-skipping grid
+    # otherwise; 'compact' / 'dense' force.  Compacted and dense grids are
+    # bit-exact, so this is purely a scheduling/DMA-traffic choice.
+    attn_grid: str = "auto"
+    # VFA-style global-max forward on the compacted grid (precompute row
+    # maxima in a max-only pass, skip the per-tile accumulator rescale).
+    # allclose — not bit-identical — to the online-softmax forward, so opt-in.
+    attn_vfa: bool = False
+    # sparse-aware cached/paged decode: pattern layers gather only the keys
+    # their pattern permits (Kmax per step) instead of attending over the full
+    # seq_len cache — what makes seq-4096 (fmap 64) sampling tractable.
+    sparse_decode: bool = True
 
     @property
     def inner_dim(self) -> int:
@@ -407,7 +421,8 @@ def _use_ring(cfg, pattern, key_mask) -> bool:
     )
 
 
-def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
+def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None,
+                    tables=None):
     b, n, _ = x.shape
     q, k, v = _qkv_heads(
         shared, cfg, x, None if rotary is None else rotary[:n], checkpoint=True
@@ -444,7 +459,8 @@ def _attention_full(shared, cfg, x, pattern, rotary, key_mask, dkey, live=None):
         km = key_mask[:, :n] if key_mask is not None else None
         out = flash_attention(
             q, k, v, mask=pm, causal=cfg.causal, scale=cfg.dim_head ** -0.5,
-            live=live, key_mask=km,
+            live=live, key_mask=km, grid=cfg.attn_grid, tables=tables,
+            vfa=cfg.attn_vfa,
         )
         out = linear(shared["out"], _merge_heads(out))
         return apply_dropout(dkey, out, cfg.attn_dropout)
@@ -482,7 +498,7 @@ def _feed_forward(shared, cfg, x, dkey):
 
 
 def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask,
-                       live=None):
+                       live=None, tables=None):
     """Length-n prefix attention that also fills the KV cache from offset 0.
     Mutates layer_cache['k'/'v'] (caller passes a fresh dict copy)."""
     b, n, _ = x.shape
@@ -504,7 +520,8 @@ def _attention_prefill(shared, cfg, layer_cache, x, pattern, rotary, key_mask,
         km = key_mask[:, :n] if key_mask is not None else None
         out = flash_attention(
             q, k, v, mask=pm, causal=True, scale=cfg.dim_head ** -0.5,
-            key_mask=km, live=live,
+            key_mask=km, live=live, grid=cfg.attn_grid, tables=tables,
+            vfa=cfg.attn_vfa,
         )
         return linear(shared["out"], _merge_heads(out))
     q = q * (cfg.dim_head ** -0.5)
@@ -533,6 +550,8 @@ def _residual_branch(
     key_mask=None,
     dkey=None,
     live=None,
+    tables=None,
+    decode_tab=None,
     layer_cache: Optional[dict] = None,
     offset=None,
     text_mode: bool = False,
@@ -563,17 +582,21 @@ def _residual_branch(
             h = token_shift(h, cfg.seq_len, cfg.image_fmap_size)
     if kind == "attn":
         if mode == "full":
-            h = _attention_full(attn_params, cfg, h, pattern, rotary, key_mask, dkey, live=live)
+            h = _attention_full(
+                attn_params, cfg, h, pattern, rotary, key_mask, dkey, live=live,
+                tables=tables,
+            )
         elif mode == "prefill":
             layer_cache = dict(layer_cache)
             h = _attention_prefill(
                 attn_params, cfg, layer_cache, h, pattern, rotary, key_mask,
-                live=live,
+                live=live, tables=tables,
             )
         else:
             layer_cache = dict(layer_cache)
             h, (layer_cache["k"], layer_cache["v"]) = _attention_cached(
-                attn_params, cfg, layer_cache, h, pattern, rotary, offset
+                attn_params, cfg, layer_cache, h, pattern, rotary, offset,
+                decode_tab=decode_tab,
             )
     else:
         h = _feed_forward(ff_params, cfg, h, dkey)
@@ -730,6 +753,95 @@ def _stacked_masks(cfg, specs, n: int):
     return np.stack(masks_np), midx
 
 
+def _stacked_flash_tables(cfg, masks_np, n: int, bq: int, bk: int, causal: bool):
+    """Stacked compacted-grid index tables for the scan paths — one table set
+    per DISTINCT pattern, padded to a common grid length (lax.scan selects a
+    TRACED mask per layer, which defeats flash_attention's trace-time table
+    build; the grid size must also be layer-invariant).  Returns a dict of
+    (D, 1, T)/(D, 1, T2) jnp arrays keyed by sparse_index.TABLE_KEYS, or None
+    when the dense grid is the right call (attn_grid='dense', or 'auto' with
+    no pattern killing tiles inside the causal triangle)."""
+    import numpy as np
+
+    if cfg.attn_grid == "dense":
+        return None
+    from dalle_pytorch_tpu.kernels.sparse_index import (
+        TABLE_KEYS, block_causal_live_np, build_compacted_tables,
+    )
+    from dalle_pytorch_tpu.ops.masks import block_live_np
+
+    lives = [block_live_np(m, bq, bk) for m in masks_np]
+    if cfg.attn_grid == "auto":
+        cl = (
+            block_causal_live_np(n // bq, n // bk, bq, bk)
+            if causal else np.ones((n // bq, n // bk), bool)
+        )
+        if all(bool(np.all(lv | ~cl)) for lv in lives):
+            return None
+    per = [build_compacted_tables(lv, bq, bk, causal=causal) for lv in lives]
+    pad = (
+        max(t["qrow"].shape[-1] for t in per),
+        max(t["qrowT"].shape[-1] for t in per),
+    )
+    per = [
+        build_compacted_tables(lv, bq, bk, causal=causal, pad_to=pad)
+        for lv in lives
+    ]
+    return {k: jnp.asarray(np.stack([t[k] for t in per])) for k in TABLE_KEYS}
+
+
+def _select_flash_tables(tabstk, mi):
+    """Per-layer table tuple (TABLE_KEYS order) from the stacked tables, by
+    traced layer index."""
+    if tabstk is None:
+        return None
+    from dalle_pytorch_tpu.kernels.sparse_index import TABLE_KEYS
+
+    return tuple(jnp.take(tabstk[k], mi, axis=0, mode="clip") for k in TABLE_KEYS)
+
+
+def _stacked_decode_tables(cfg, specs):
+    """Stacked sparse-decode gather tables (idx (D, n, Kmax), counts (D, n))
+    for the scan decode paths, or None when sparse decode doesn't pay: any
+    'full' layer in the stack forces Kmax = seq_len (the scan pads every
+    pattern to the widest gather), which is the dense read it was meant to
+    avoid.  The unrolled decode paths decide per layer instead."""
+    import numpy as np
+
+    if not cfg.sparse_decode:
+        return None
+    distinct = list(dict.fromkeys(_pattern_key(s) for s in specs))
+    pats = [_pattern_for(cfg, t, seed) for t, seed in distinct]
+    if any(p is None for p in pats):
+        return None
+    from dalle_pytorch_tpu.kernels.sparse_index import (
+        build_decode_tables, decode_kv_span,
+    )
+
+    kmax = max(decode_kv_span(p, cfg.seq_len) for p in pats)
+    tabs = [build_decode_tables(p, pad_to=kmax) for p in pats]
+    return (
+        jnp.asarray(np.stack([t[0] for t in tabs])),
+        jnp.asarray(np.stack([t[1] for t in tabs])),
+    )
+
+
+def _decode_tables_by_key(cfg, patterns):
+    """Sparse-decode gather tables per pattern key for the UNROLLED decode
+    paths ('full' layers stay on the dense cache read; pattern layers each
+    get their own minimal Kmax)."""
+    if not cfg.sparse_decode:
+        return {}
+    from dalle_pytorch_tpu.kernels.sparse_index import build_decode_tables
+
+    out = {}
+    for key, pm in patterns.items():
+        if pm is not None:
+            idx, counts = build_decode_tables(pm)
+            out[key] = (jnp.asarray(idx), jnp.asarray(counts))
+    return out
+
+
 def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rotary):
     """lax.scan over stacked per-layer params.  Per-layer attention patterns
     become a traced select from a stacked mask array (with stacked Pallas
@@ -754,16 +866,19 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
             m.reshape(n // bq, bq, n // bk, bk).any(axis=(1, 3)).astype(np.int32)
             for m in masks_np
         ]))
+        tabstk = _stacked_flash_tables(cfg, masks_np, n, bq, bk, cfg.causal)
     except ValueError:  # no valid block: the flash path won't be taken anyway
         lives = None
+        tabstk = None
     masks = jnp.asarray(masks_np)
 
     stacked = _stacked_bundles(params, specs)
 
-    def run_branch(bundle, h, kind, mask, live, dkey):
+    def run_branch(bundle, h, kind, mask, live, tabs, dkey):
         out, _ = _residual_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, kind,
             rotary=rotary, pattern=mask, key_mask=key_mask, dkey=dkey, live=live,
+            tables=tabs,
         )
         return out
 
@@ -776,9 +891,10 @@ def _apply_scan(params, cfg, x, key_mask, layer_keys, seq_constraint, specs, rot
             akey = fkey = None
         mask = jnp.take(masks, mi, axis=0, mode="clip")
         live = jnp.take(lives, mi, axis=0, mode="clip") if lives is not None else None
-        h = h + run_branch(bundle, h, "attn", mask, live, akey)
+        tabs = _select_flash_tables(tabstk, mi)
+        h = h + run_branch(bundle, h, "attn", mask, live, tabs, akey)
         h = seq_constraint(h)
-        h = h + run_branch(bundle, h, "ff", mask, live, fkey)
+        h = h + run_branch(bundle, h, "ff", mask, live, tabs, fkey)
         return seq_constraint(h), None
 
     if cfg.execution == "remat":
@@ -883,8 +999,17 @@ def _shift_cached_step(cfg, rb, x, offset):
     return shifted, rb
 
 
-def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset):
-    """Single-token cached attention.  x: (b, 1, dim).  Returns (out, (k, v))."""
+def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset,
+                      decode_tab=None):
+    """Single-token cached attention.  x: (b, 1, dim).  Returns (out, (k, v)).
+
+    `decode_tab`: optional sparse-decode gather tables (idx, counts) from
+    sparse_index.build_decode_tables — idx[..., t, :] lists the pattern's
+    permitted key positions {j <= t} and already folds in both causality and
+    the pattern row, so the step gathers Kmax keys instead of attending over
+    the full seq_len cache.  Padded gather slots are masked off by counts
+    (their exp underflows to exactly 0.0, like the dense path's masked
+    positions), so results match the full-cache row-mask path."""
     ang = (
         None if rotary is None
         else jax.lax.dynamic_slice(rotary, (offset, 0), (1, rotary.shape[1]))
@@ -898,6 +1023,27 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset):
     v_buf = jax.lax.dynamic_update_slice(
         layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, offset, 0)
     )
+
+    if decode_tab is not None:
+        idx, counts = decode_tab
+        kmax = idx.shape[-1]
+        if idx.ndim == 3:  # per-head (h, n, Kmax)
+            sel = jax.lax.dynamic_slice(
+                idx, (0, offset, 0), (idx.shape[0], 1, kmax))[:, 0]  # (h, Kmax)
+            cnt = jax.lax.dynamic_slice(
+                counts, (0, offset), (counts.shape[0], 1))[:, 0]  # (h,)
+            k_sel = jnp.take_along_axis(k_buf, sel[None, :, :, None], axis=2)
+            v_sel = jnp.take_along_axis(v_buf, sel[None, :, :, None], axis=2)
+            amask = (jnp.arange(kmax)[None, :] < cnt[:, None])[None, :, None, :]
+        else:  # shared (n, Kmax)
+            sel = jax.lax.dynamic_slice(idx, (offset, 0), (1, kmax))[0]
+            cnt = jax.lax.dynamic_slice(counts, (offset,), (1,))[0]
+            k_sel = jnp.take(k_buf, sel, axis=2)
+            v_sel = jnp.take(v_buf, sel, axis=2)
+            amask = (jnp.arange(kmax) < cnt)[None, None, None, :]
+        out = attend(q, k_sel, v_sel, mask=amask, stable=cfg.stable)
+        out = linear(shared["out"], _merge_heads(out))
+        return out, (k_buf, v_buf)
 
     j = jnp.arange(cfg.seq_len)
     mask = j <= offset
@@ -956,6 +1102,7 @@ def _run_cached_scan(params, cfg, specs, x, cache, mode, rotary, key_mask=None,
     stacked = _stacked_bundles(params, specs)
 
     lives = None
+    tabstk = None
     if mode == "prefill":
         # the scan selects a TRACED mask per layer, which defeats the flash
         # kernel's trace-time liveness derivation — build the stacked tables
@@ -973,23 +1120,37 @@ def _run_cached_scan(params, cfg, specs, x, cache, mode, rotary, key_mask=None,
                 .any(axis=(1, 3)).astype(np.int32)
                 for m in masks_np
             ]))
+            tabstk = _stacked_flash_tables(
+                cfg, [m[:n, :n] for m in masks_np], n, bq, bk, True
+            )
         except ValueError:  # no valid block: the flash path won't be taken
             lives = None
+
+    dec_tabs = _stacked_decode_tables(cfg, specs) if mode == "decode" else None
 
     def body(h, xs):
         bundle, mi, lc = xs
         mask = jnp.take(masks, mi, axis=0)
         live = jnp.take(lives, mi, axis=0, mode="clip") if lives is not None else None
+        tabs = _select_flash_tables(tabstk, mi)
+        dtab = None
+        if dec_tabs is not None:
+            dtab = (
+                jnp.take(dec_tabs[0], mi, axis=0, mode="clip"),
+                jnp.take(dec_tabs[1], mi, axis=0, mode="clip"),
+            )
         fa, lc = _residual_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "attn",
             mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
             layer_cache=lc, offset=offset, text_mode=text_only, live=live,
+            tables=tabs, decode_tab=dtab,
         )
         h = h + fa
         fb, lc = _residual_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "ff",
             mode=mode, rotary=rotary, pattern=mask, key_mask=key_mask,
             layer_cache=lc, offset=offset, text_mode=text_only, live=live,
+            tables=tabs, decode_tab=dtab,
         )
         return h + fb, lc
 
@@ -1018,6 +1179,7 @@ def decode_step(
         return out, {"offset": offset + 1, "layers": new_layers}
 
     patterns = spec_patterns(cfg, specs)
+    dec_tabs = _decode_tables_by_key(cfg, patterns)
 
     def branch(spec, x, kind, layer_cache):
         return _residual_branch(
@@ -1025,6 +1187,7 @@ def decode_step(
             params["shared_ff"][spec.ff_id], x, kind, mode="decode",
             rotary=rotary, pattern=patterns[_pattern_key(spec)],
             layer_cache=layer_cache, offset=offset, text_mode=text_only,
+            decode_tab=dec_tabs.get(_pattern_key(spec)),
         )
 
     out, new_layers = _run_cached_layers(cfg, specs, x, cache, branch)
@@ -1205,7 +1368,7 @@ def write_prefill_to_pool(
 
 
 def _paged_attention_step(shared, cfg, layer_pool, block_tables, offsets, x,
-                          pattern, rotary):
+                          pattern, rotary, decode_tab=None):
     """Per-slot cached attention over the paged pool.  x: (S, 1, dim);
     block_tables: (S, max_blocks); offsets: (S,).  Each slot gathers its
     blocks into a dense (h, seq_len, dh) view and runs the SAME
@@ -1220,7 +1383,8 @@ def _paged_attention_step(shared, cfg, layer_pool, block_tables, offsets, x,
         k = k.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
         v = v.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
         out, (k2, v2) = _attention_cached(
-            shared, cfg, {"k": k, "v": v}, x_s[None], pattern, rotary, off_s
+            shared, cfg, {"k": k, "v": v}, x_s[None], pattern, rotary, off_s,
+            decode_tab=decode_tab,
         )
         new_k = jax.lax.dynamic_slice(
             k2, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
@@ -1259,7 +1423,8 @@ def _paged_shift_step(cfg, ring, x, offsets):
 
 
 def _paged_branch(cfg, wrap, attn_params, ff_params, x, kind, layer_pool,
-                  block_tables, offsets, ring, pattern, rotary):
+                  block_tables, offsets, ring, pattern, rotary,
+                  decode_tab=None):
     """Decode-mode residual branch over paged per-slot state — the same
     composition as `_residual_branch(mode='decode')` with vectors where that
     path has scalars.  Returns (branch out, new ring, new KV cols or None)."""
@@ -1270,7 +1435,8 @@ def _paged_branch(cfg, wrap, attn_params, ff_params, x, kind, layer_pool,
     cols = None
     if kind == "attn":
         h, cols = _paged_attention_step(
-            attn_params, cfg, layer_pool, block_tables, offsets, h, pattern, rotary
+            attn_params, cfg, layer_pool, block_tables, offsets, h, pattern,
+            rotary, decode_tab=decode_tab,
         )
     else:
         h = _feed_forward(ff_params, cfg, h, None)
@@ -1308,12 +1474,14 @@ def paged_decode_step(
         )
 
     patterns = spec_patterns(cfg, specs)
+    dec_tabs = _decode_tables_by_key(cfg, patterns)
 
     def branch(spec, h, kind, layer_pool, ring):
         return _paged_branch(
             cfg, params["layers"][spec.index], params["shared_attn"][spec.attn_id],
             params["shared_ff"][spec.ff_id], h, kind, layer_pool, block_tables,
             offsets, ring, patterns[_pattern_key(spec)], rotary,
+            decode_tab=dec_tabs.get(_pattern_key(spec)),
         )
 
     new_pool_layers, new_ring_layers = [], []
@@ -1372,6 +1540,7 @@ def _paged_decode_scan(params, cfg, specs, x, pool, block_tables, offsets,
     masks_np, midx = _stacked_masks(cfg, specs, cfg.seq_len)
     masks = jnp.asarray(masks_np)
     stacked = _stacked_bundles(params, specs)
+    dec_tabs = _stacked_decode_tables(cfg, specs)
 
     def body(h, xs):
         if cfg.shift_tokens:
@@ -1380,17 +1549,23 @@ def _paged_decode_scan(params, cfg, specs, x, pool, block_tables, offsets,
             bundle, mi, lp = xs
             ring_layer = None
         mask = jnp.take(masks, mi, axis=0)
+        dtab = None
+        if dec_tabs is not None:
+            dtab = (
+                jnp.take(dec_tabs[0], mi, axis=0, mode="clip"),
+                jnp.take(dec_tabs[1], mi, axis=0, mode="clip"),
+            )
         r_attn = ring_layer["shift_attn"] if cfg.shift_tokens else None
         fa, r_attn, cols = _paged_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "attn",
-            lp, block_tables, offsets, r_attn, mask, rotary,
+            lp, block_tables, offsets, r_attn, mask, rotary, decode_tab=dtab,
         )
         lp = _paged_scatter_cols(lp, block_tables, offsets, cols, block_size)
         h = h + fa
         r_ff = ring_layer["shift_ff"] if cfg.shift_tokens else None
         fb, r_ff, _ = _paged_branch(
             cfg, bundle["wrap"], bundle["attn"], bundle["ff"], h, "ff",
-            lp, block_tables, offsets, r_ff, mask, rotary,
+            lp, block_tables, offsets, r_ff, mask, rotary, decode_tab=dtab,
         )
         ys = (lp, {"shift_attn": r_attn, "shift_ff": r_ff}) if cfg.shift_tokens else lp
         return h + fb, ys

@@ -480,7 +480,63 @@ def sampling_memory_ledger(cfg: Any, batch: int, params: Any = None,
                      "detail": f"2 x depth x b{batch} x s{cfg.total_seq_len} x h x dh"})
     rows.append({"name": "logits", "bytes": 1.0 * batch * cfg.total_tokens * 4,
                  "detail": "per-position vocab logits (f32)"})
+    extra = _decode_read_accounting(cfg, batch, itemsize)
+    if extra is not None:
+        gather_row, read_bytes = extra
+        rows.append(gather_row)
+        return _finish_ledger(rows, batch=batch, capacity_bytes=capacity_bytes,
+                              decode_kv_read_bytes_per_step=read_bytes)
     return _finish_ledger(rows, batch=batch, capacity_bytes=capacity_bytes)
+
+
+def _decode_read_accounting(cfg: Any, batch: int, itemsize: int):
+    """Pattern-limited decode-read pricing for the sparse-aware decode
+    (models/transformer._attention_cached with decode tables): per step each
+    pattern layer gathers only its Kmax permitted keys instead of reading the
+    full seq_len cache row.  Returns (transient gather row, per-step KV read
+    bytes summed over layers) — the row is the (b, h, Kmax, dh) K/V transient
+    (one layer live at a time, so max over layers), the read total is what
+    the decode step actually moves, shared by construction with
+    sparse_index.decode_kv_span.  None when the config has no transformer
+    view or sparse decode is off (full-cache reads are already priced by the
+    kv_cache row's width)."""
+    if not hasattr(cfg, "transformer_config"):
+        return None
+    try:
+        tcfg = cfg.transformer_config()
+    except Exception:
+        return None
+    if not getattr(tcfg, "sparse_decode", False):
+        return None
+    from dalle_pytorch_tpu.kernels.sparse_index import decode_kv_span
+    from dalle_pytorch_tpu.models.transformer import (
+        _pattern_for, _pattern_key, derive_layer_specs,
+    )
+
+    n = tcfg.seq_len
+    spans = {}
+    read_bytes = 0.0
+    kmax = 0
+    any_pattern = False
+    for spec in derive_layer_specs(tcfg):
+        key = _pattern_key(spec)
+        if key not in spans:
+            pm = _pattern_for(tcfg, key[0], key[1])
+            spans[key] = decode_kv_span(pm, n)
+            any_pattern |= pm is not None
+        span = spans[key]
+        read_bytes += 2.0 * batch * tcfg.heads * span * tcfg.dim_head * itemsize
+        if span < n:  # full layers read the cache in place, no gather
+            kmax = max(kmax, span)
+    if not any_pattern:
+        return None
+    row = {
+        "name": "decode_gather",
+        "bytes": 2.0 * batch * tcfg.heads * kmax * tcfg.dim_head * itemsize,
+        "detail": (f"sparse decode K/V gather, Kmax {kmax} of s{n} "
+                   "(transient, one layer)"),
+    }
+    return row, read_bytes
 
 
 def publish_gauges(ledger: Mapping[str, Any], registry=None) -> None:

@@ -164,6 +164,22 @@ def build_block_sparse_mask(
     )
 
 
+def block_live_np(mask: np.ndarray, block_q: int, block_k: int) -> np.ndarray:
+    """Tile-granular liveness of a static pattern mask: (nq, nk) bool — or
+    (h, nq, nk) for per-head masks — True where the (block_q, block_k) tile
+    has at least one allowed element.  THE block-liveness table the flash
+    kernels skip dead tiles by and the compacted-grid index builder
+    (kernels/sparse_index.py) flattens; must be built at resolve_block()
+    granularity."""
+    m = np.asarray(mask, dtype=bool)  # host-sync-ok: static trace-time mask
+    n = m.shape[-1]
+    assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
+    nq, nk = n // block_q, n // block_k
+    if m.ndim == 3:
+        return m.reshape(m.shape[0], nq, block_q, nk, block_k).any(axis=(2, 4))
+    return m.reshape(nq, block_q, nk, block_k).any(axis=(1, 3))
+
+
 def build_pattern_mask(
     attn_type: str,
     seq_len: int,

@@ -86,13 +86,66 @@ def _attn_live_density(cfg) -> float:
     return sum(dens) / len(dens)
 
 
-def dalle_step_flops(cfg, batch: int, n_matmul_params: int, with_backward: bool = True) -> float:
+def _attn_tile_density(cfg) -> float:
+    """Live fraction of the (s, s) score matrix at the flash kernels' TILE
+    granularity: a (block_q, block_k) tile with a single live element is
+    computed in full, so executed-FLOPs accounting must price whole live
+    tiles — element-granular density understates kernel work for ragged
+    patterns, overstating the remaining headroom.  Mirrors the block-liveness
+    the kernels skip/compact by (ops.masks.block_live_np +
+    sparse_index.block_causal_live_np at resolve_block granularity); falls
+    back to element density when no kernel block divides the sequence (the
+    dense-XLA path masks elementwise)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.kernels.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, resolve_block,
+    )
+    from dalle_pytorch_tpu.kernels.sparse_index import block_causal_live_np
+    from dalle_pytorch_tpu.models.transformer import (
+        _pattern_for, _pattern_key, derive_layer_specs,
+    )
+    from dalle_pytorch_tpu.ops.masks import block_live_np
+
+    tcfg = cfg.transformer_config() if hasattr(cfg, "transformer_config") else cfg
+    n = tcfg.seq_len
+    try:
+        bq = resolve_block(n, DEFAULT_BLOCK_Q)
+        bk = resolve_block(n, DEFAULT_BLOCK_K)
+    except ValueError:
+        return _attn_live_density(cfg)
+    cl = block_causal_live_np(n // bq, n // bk, bq, bk)
+    cache: dict = {}
+    dens = []
+    for spec in derive_layer_specs(tcfg):
+        key = _pattern_key(spec)
+        if key not in cache:
+            pm = _pattern_for(tcfg, key[0], key[1])
+            if pm is None:
+                cache[key] = float(cl.mean())
+            else:
+                bl = block_live_np(np.asarray(pm), bq, bk)
+                cache[key] = float((bl & cl).mean())  # per-head bl broadcasts
+        dens.append(cache[key])
+    return sum(dens) / len(dens)
+
+
+def dalle_step_flops(cfg, batch: int, n_matmul_params: int, with_backward: bool = True,
+                     granularity: str = "element") -> float:
     """Analytic FLOPs for one (micro)step: 2*P*T matmul cost + attention
     scores/values priced at each layer's live (pattern & causal) density;
-    backward ≈ 2x forward."""
+    backward ≈ 2x forward.
+
+    granularity='element' prices the algorithmic density (what the math
+    requires); 'tile' prices whole live kernel tiles — what the flash kernels
+    actually execute, and therefore what the XLA cost crosscheck and the
+    bench MFU must be compared against for sparse configs."""
     s = cfg.total_seq_len
     proj = 2.0 * n_matmul_params * batch * s
-    density = _attn_live_density(cfg)
+    density = (
+        _attn_tile_density(cfg) if granularity == "tile"
+        else _attn_live_density(cfg)
+    )
     attn = 2.0 * 2.0 * batch * cfg.heads * s * s * cfg.dim_head * density * cfg.depth
     fwd = proj + attn
     return (3.0 if with_backward else 1.0) * fwd

@@ -1,0 +1,439 @@
+"""Compacted-grid block-sparse flash attention (PR 8).
+
+Bit-parity of the compacted (scalar-prefetch) grid against the dense
+pl.when-skipping grid: the compacted kernels visit the same live tiles in
+the same order, so every float op sequence — forward online softmax, dq
+row accumulation, dk/dv column accumulation — is identical and the outputs
+must match to the last bit (np.testing.assert_array_equal, not allclose).
+
+Also covered: the sparse_index table builders (liveness round-trip,
+placeholder/padding semantics, decode gather tables vs brute force),
+per-head sparse layouts, key-mask interaction, the VFA two-pass forward
+(allclose by design — fixed-max accumulation reorders the sums),
+scan_layers stacked tables, sparse-aware cached decode, resolve_block's
+divisor fallback, and the seq-4096 axial scenario (tile-count speedup
+ratio asserted on CPU; ledger verdict + decode gather width).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.kernels.flash_attention import (
+    DEFAULT_BLOCK_Q,
+    flash_attention,
+    resolve_block,
+)
+from dalle_pytorch_tpu.kernels import sparse_index as si
+from dalle_pytorch_tpu.models.transformer import (
+    TransformerConfig,
+    _pattern_for,
+    apply_transformer,
+    decode_step,
+    init_cache,
+    init_transformer,
+    prefill,
+)
+from dalle_pytorch_tpu.ops.masks import ATTN_TYPES, block_live_np
+
+# 3x3 tile grid at 128x128: big enough that axial/conv/sparse patterns kill
+# tiles inside the causal triangle, small enough for interpret mode
+N, FMAP, BLOCK = 384, 16, 128
+DIM = 32
+
+
+def _tcfg(**kw):
+    base = dict(
+        dim=DIM, depth=1, seq_len=N, heads=2, dim_head=DIM,
+        image_fmap_size=FMAP, sparse_block_size=16,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def qkv(b=1, h=1, n=N, d=DIM, seed=0):
+    # h=1 default: the grid is (b*h, T), so single-head halves interpret-mode
+    # work; multi-head broadcast/layout is covered by the per-head test
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d), jnp.float32) for i in range(3))
+    do = jax.random.normal(ks[3], (b, h, n, d), jnp.float32)
+    return q, k, v, do
+
+
+def _run(grid, mask, q, k, v, do, **kw):
+    """(out, dq, dk, dv) for one grid choice; the loss contracts with a fixed
+    random cotangent so every output element influences every grad."""
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, mask=mask, block_q=BLOCK, block_k=BLOCK,
+                              grid=grid, **kw)
+        return jnp.sum(out * do), out
+
+    (_, out), grads = jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    return (np.asarray(out),) + tuple(np.asarray(g) for g in grads)
+
+
+# every pattern runs the same kernel code path — they differ only in which
+# tiles the tables mark live — so tier-1 keeps the banded flagship
+# (axial_row) and the irregular per-block layout (sparse); the rest ride the
+# slow suite to respect the tier-1 time budget
+_SLOW_PATTERNS = ("full", "axial_col", "conv_like")
+
+
+@pytest.mark.parametrize(
+    "attn_type",
+    [pytest.param(t, marks=pytest.mark.slow) if t in _SLOW_PATTERNS else t
+     for t in ATTN_TYPES],
+)
+def test_compact_matches_dense_grid_bitexact(attn_type):
+    """Forward + dq + dk/dv bit-parity for every pattern ('full' runs the
+    causal-only tables: mask=None, liveness = the causal triangle)."""
+    mask = _pattern_for(_tcfg(), attn_type)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+    q, k, v, do = qkv()
+    dense = _run("dense", mask, q, k, v, do)
+    compact = _run("compact", mask, q, k, v, do)
+    for a, b in zip(dense, compact):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_compact_per_head_sparse_bitexact():
+    """Per-head random block layouts need per-head tables (H == h); the
+    union-table shortcut would let dead tiles contribute exp(0)=1 mass."""
+    cfg = _tcfg(sparse_per_head=True)
+    mask = jnp.asarray(_pattern_for(cfg, "sparse"))
+    assert mask.ndim == 3 and mask.shape[0] == cfg.heads
+    q, k, v, do = qkv(h=cfg.heads)
+    dense = _run("dense", mask, q, k, v, do)
+    compact = _run("compact", mask, q, k, v, do)
+    for a, b in zip(dense, compact):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_compact_per_head_mask_requires_per_head_tables():
+    cfg = _tcfg(sparse_per_head=True)
+    mask = jnp.asarray(_pattern_for(cfg, "sparse"))
+    q, k, v, _ = qkv(h=cfg.heads)
+    shared = si.build_compacted_tables(
+        np.ones((N // BLOCK, N // BLOCK), np.int32), BLOCK, BLOCK)
+    with pytest.raises(ValueError, match="per-head"):
+        flash_attention(q, k, v, mask=mask, block_q=BLOCK, block_k=BLOCK,
+                        grid="compact", tables=shared)
+
+
+def test_compact_with_key_mask_bitexact():
+    """Traced key-padding rows compose with the static compacted tables."""
+    mask = jnp.asarray(_pattern_for(_tcfg(), "axial_row"))
+    q, k, v, do = qkv(seed=3)
+    km = (jnp.arange(N) < N - 53)[None].astype(jnp.int32)
+    dense = _run("dense", mask, q, k, v, do, key_mask=km)
+    compact = _run("compact", mask, q, k, v, do, key_mask=km)
+    for a, b in zip(dense, compact):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_vfa_forward_allclose():
+    """The VFA two-pass forward (global max first, no per-tile rescale) is
+    allclose — NOT bit-identical — to the online-softmax forward: the fixed
+    max changes the float sequence.  Backward reuses the standard kernels."""
+    mask = jnp.asarray(_pattern_for(_tcfg(), "conv_like"))
+    q, k, v, do = qkv(seed=5)
+    dense = _run("dense", mask, q, k, v, do)
+    vfa = _run("compact", mask, q, k, v, do, vfa=True)
+    for a, b in zip(dense, vfa):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=1e-4)
+
+
+def test_auto_grid_compacts_sparse_keeps_full_dense():
+    """'auto' == 'compact' for a tile-killing pattern (same bits out), and
+    falls back to the dense grid for mask=None without building tables."""
+    mask = jnp.asarray(_pattern_for(_tcfg(), "axial_row"))
+    q, k, v, do = qkv(seed=7)
+    auto = _run("auto", mask, q, k, v, do)
+    compact = _run("compact", mask, q, k, v, do)
+    for a, b in zip(auto, compact):
+        np.testing.assert_array_equal(a, b)
+    out_auto = flash_attention(q, k, v, block_q=BLOCK, block_k=BLOCK, grid="auto")
+    out_dense = flash_attention(q, k, v, block_q=BLOCK, block_k=BLOCK, grid="dense")
+    np.testing.assert_array_equal(np.asarray(out_auto), np.asarray(out_dense))
+
+
+# --- sparse_index table builders ---------------------------------------------
+
+
+def test_compacted_tables_roundtrip():
+    """Tables reproduce the exact (causal & live) tile set, row-major with
+    correct first/last flags; transposed tables reproduce it column-major;
+    fully-dead rows/columns get a placeholder (first=last=1, valid=0)."""
+    rng = np.random.RandomState(0)
+    bl = rng.rand(5, 5) < 0.4
+    bl[3, :] = False  # force a dead query row inside the causal triangle
+    tabs = si.build_compacted_tables(bl, 64, 64)
+    cl = si.block_causal_live_np(5, 5, 64, 64)
+    want = {(i, j) for i, j in zip(*np.nonzero(bl & cl))}
+
+    for qk, kk, fk, lk, vk, outer in (
+        ("qrow", "kcol", "first", "last", "valid", "qrow"),
+        ("qrowT", "kcolT", "firstT", "lastT", "validT", "kcolT"),
+    ):
+        qr, kc = tabs[qk][0], tabs[kk][0]
+        fr, la, va = tabs[fk][0], tabs[lk][0], tabs[vk][0]
+        got = {(int(i), int(j)) for i, j, v in zip(qr, kc, va) if v}
+        assert got == want
+        # every traversal group (query row / key column — dead ones included,
+        # via placeholders) opens with first=1 and closes with last=1 exactly
+        # once; no padding entries exist for unpadded tables
+        axis = tabs[outer][0]
+        opened = [int(axis[t]) for t in range(len(axis)) if fr[t]]
+        assert sorted(opened) == list(range(5)) and len(set(opened)) == 5
+        assert fr.sum() == la.sum() == 5
+        assert ((fr | la | va) == 1).all()
+
+    # placeholder for the dead query row: init+finalize, no compute
+    qr, fr, la, va = tabs["qrow"][0], tabs["first"][0], tabs["last"][0], tabs["valid"][0]
+    ph = [(f, l, v) for r, f, l, v in zip(qr, fr, la, va) if r == 3 and (f or l)]
+    assert ph == [(1, 1, 0)]
+
+
+def test_compacted_tables_padding():
+    bl = np.tril(np.ones((3, 3), bool))
+    tabs = si.build_compacted_tables(bl, 128, 128, pad_to=(10, 11))
+    assert tabs["qrow"].shape == (1, 10) and tabs["qrowT"].shape == (1, 11)
+    assert si.table_grid_sizes(tabs) == (10, 11)
+    assert si.live_tile_counts(tabs) == (6, 6)
+    # padding entries replicate the final coordinates with all-zero flags
+    assert (tabs["valid"][0, 6:] == 0).all() and (tabs["first"][0, 6:] == 0).all()
+    assert (tabs["qrow"][0, 6:] == tabs["qrow"][0, 5]).all()
+
+
+def test_decode_tables_match_brute_force():
+    cfg = _tcfg()
+    for attn_type in ("axial_row", "conv_like", "sparse"):
+        p = np.asarray(_pattern_for(cfg, attn_type), bool)
+        idx, counts = si.build_decode_tables(p)
+        assert int(counts.max()) == idx.shape[-1] == si.decode_kv_span(p, N)
+        for t in range(0, N, 37):
+            hits = np.flatnonzero(p[t, : t + 1])
+            assert counts[t] == hits.size
+            np.testing.assert_array_equal(idx[t, : hits.size], hits)
+            assert (idx[t, hits.size:] == 0).all()
+    assert si.decode_kv_span(None, N) == N
+    # per-head: one table stack per head
+    ph = np.asarray(_pattern_for(_tcfg(sparse_per_head=True), "sparse"), bool)
+    idx, counts = si.build_decode_tables(ph)
+    assert idx.ndim == 3 and idx.shape[0] == ph.shape[0]
+    for h in range(ph.shape[0]):
+        np.testing.assert_array_equal(
+            counts[h], si.decode_kv_counts(ph[h]))
+
+
+# --- resolve_block fallback (satellite 2) ------------------------------------
+
+
+def test_resolve_block_divisor_fallback():
+    assert resolve_block(640, 256) == 128  # halving path, unchanged
+    assert resolve_block(256, 256) == 256
+    # 270 = 2*3^3*5: halving bottoms out at 2 (<8); largest divisor <= cap
+    # is 135 — previously a ValueError, now a working (if unaligned) block
+    assert resolve_block(270, 256) == 135
+    assert resolve_block(270, 135) == 135
+    # 2305 = 5*461: no divisor in [8, 256] exists — the error must say so
+    with pytest.raises(ValueError, match="no divisor"):
+        resolve_block(2305, DEFAULT_BLOCK_Q)
+
+
+# --- transformer integration -------------------------------------------------
+
+
+def _scan_cfg():
+    return _tcfg(
+        depth=2, dim_head=16, attn_types=("axial_row", "conv_like"),
+        shift_tokens=True, scan_layers=True, attn_kernel="flash",
+    )
+
+
+def test_scan_layers_stacked_tables_bitexact():
+    """scan_layers selects per-layer tables out of a stacked (depth-padded)
+    array by traced index; the forward must match the dense grid bit-for-bit.
+    (Forward-only to stay inside the tier-1 time budget — the grad legs and
+    the unrolled cross-check live in the slow companion below.)"""
+    cfg = _scan_cfg()
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, N, cfg.dim), jnp.float32)
+    o_dense = apply_transformer(params, dataclasses.replace(cfg, attn_grid="dense"), x)
+    o_comp = apply_transformer(params, dataclasses.replace(cfg, attn_grid="compact"), x)
+    np.testing.assert_array_equal(np.asarray(o_dense), np.asarray(o_comp))
+
+
+@pytest.mark.slow
+def test_scan_layers_stacked_tables_grads_bitexact():
+    """Grad legs of the scan stacked-table parity: input grads match the
+    dense grid bit-for-bit (the dq and dk/dv compacted kernels under the
+    traced table select), and the unrolled compact path is allclose."""
+    cfg = _scan_cfg()
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, N, cfg.dim), jnp.float32)
+
+    def run(c):
+        f = lambda x: jnp.sum(jnp.sin(apply_transformer(params, c, x)))
+        out = apply_transformer(params, c, x)
+        return np.asarray(out), np.asarray(jax.grad(f)(x))
+
+    o_dense, g_dense = run(dataclasses.replace(cfg, attn_grid="dense"))
+    o_comp, g_comp = run(dataclasses.replace(cfg, attn_grid="compact"))
+    np.testing.assert_array_equal(o_dense, o_comp)
+    np.testing.assert_array_equal(g_dense, g_comp)
+    # scan vs unrolled is allclose only — the scan itself reorders
+    # NON-attention float ops (stacked-param layout), dense grid included
+    o_unrl, g_unrl = run(dataclasses.replace(cfg, attn_grid="compact",
+                                             scan_layers=False))
+    np.testing.assert_allclose(o_dense, o_unrl, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(g_dense, g_unrl, atol=1e-5, rtol=1e-5)
+
+
+def _decode_roll(cfg, params, x_prefix, n_steps):
+    """prefill the prefix, then decode n_steps single tokens; returns the
+    stacked decode outputs."""
+    cache = init_cache(cfg, x_prefix.shape[0])
+    _, cache = prefill(params, cfg, x_prefix, cache)
+    outs = []
+    step = jax.jit(lambda x, c: decode_step(params, cfg, x, c))
+    for t in range(n_steps):
+        x_t = x_prefix[:, -1:] * (0.1 * t + 1.0)
+        out, cache = step(x_t, cache)
+        outs.append(np.asarray(out))
+    return np.stack(outs)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(attn_types=("axial_row", "conv_like")),
+    dict(attn_types=("sparse",), sparse_per_head=True),
+    # scan_layers sparse decode is covered end-to-end by test_sampling's
+    # scan greedy-oracle case (sparse_decode defaults on) — not repeated here
+])
+def test_sparse_decode_matches_full_cache(kw):
+    """Sparse-aware decode gathers only the pattern-permitted keys.  The
+    row-masked full-cache softmax and the gathered softmax see the same live
+    scores, but XLA sums them with different reduction-tree widths (Kmax vs
+    seq_len), so parity is to reduction-order ulp, not bitwise — the tight
+    atol below fails loudly if the gather ever selects a wrong key."""
+    cfg = _tcfg(depth=2, dim_head=16, image_fmap_size=8, seq_len=80,
+                shift_tokens=True, **kw)
+    params = init_transformer(jax.random.PRNGKey(2), cfg)
+    # prefix ends inside the image region (cached decode's domain)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, cfg.text_len + 5, cfg.dim))
+    sparse = _decode_roll(cfg, params, x, 4)
+    full = _decode_roll(dataclasses.replace(cfg, sparse_decode=False), params, x, 4)
+    np.testing.assert_allclose(sparse, full, atol=2e-6, rtol=2e-6)
+
+
+# --- seq-4096 scenario -------------------------------------------------------
+
+
+def test_seq4096_axial_tile_ratio():
+    """At 64x64 fmaps (seq 4096 image side) the compacted grid runs >= 4x
+    fewer tiles than the dense causal grid for axial patterns — the static
+    tile counts ARE the speedup model (each live tile costs the same MXU
+    work), so the ratio is asserted here on CPU and measured as step time by
+    bench.py's sparse_attention rows on TPU."""
+    n = 4096
+    cfg = _tcfg(seq_len=n, image_fmap_size=64)
+    # 128x128 tiles: a query block spans 2 image rows, so axial_row's live
+    # band stays narrow (at 256 the one-row block misalignment from the text
+    # prefix drags the ratio to ~3x; axial_col connects every row of a column
+    # and is tile-dense at any block >= fmap — it rides the text/causal skip
+    # only, which is why the scenario pairs it with axial_row layers)
+    bq = resolve_block(n, 128)
+    nq = n // bq
+    dense_tiles = int(si.block_causal_live_np(nq, nq, bq, bq).sum())
+    mask = np.asarray(_pattern_for(cfg, "axial_row"), bool)
+    tabs = si.build_compacted_tables(block_live_np(mask, bq, bq), bq, bq)
+    fwd_live, dkv_live = si.live_tile_counts(tabs)
+    assert dense_tiles / fwd_live >= 4.0, (dense_tiles, fwd_live)
+    assert dense_tiles / dkv_live >= 4.0, (dense_tiles, dkv_live)
+
+
+def _seq4096_cfg():
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+
+    return DALLEConfig(
+        dim=32, depth=2, num_text_tokens=64, text_seq_len=256, heads=2,
+        dim_head=16, num_image_tokens=32, image_fmap_size=64,
+        attn_types=("axial_row", "axial_col"), shift_tokens=True,
+    )
+
+
+def test_seq4096_scenario_ledger_and_knobs():
+    """image_fmap_size=64 (seq 4352): the sampling ledger's HBM verdict holds
+    (the decode-gather row prices Kmax reads, far below the full cache), and
+    the grid/decode knobs ride DALLEConfig -> transformer_config().  The
+    actual seq-4352 decode roll lives in the slow e2e test below; sparse
+    decode parity runs tier-1 at seq 80 above."""
+    cfg = _seq4096_cfg()
+    from dalle_pytorch_tpu.observability.memory import sampling_memory_ledger
+
+    led = sampling_memory_ledger(cfg, 1, itemsize=4, capacity_bytes=16e9)
+    assert led["fits"] is True
+    rows = {r["name"]: r for r in led["rows"]}
+    assert "decode_gather" in rows
+    # axial patterns bound the gather width well below the sequence length
+    tcfg = cfg.transformer_config()
+    spans = [si.decode_kv_span(np.asarray(_pattern_for(tcfg, t), bool),
+                               cfg.total_seq_len)
+             for t in cfg.attn_types]
+    assert max(spans) < cfg.total_seq_len // 4
+    assert led["decode_kv_read_bytes_per_step"] < (
+        2 * cfg.depth * cfg.heads * cfg.total_seq_len * cfg.dim_head * 4)
+
+    # the knobs ride DALLEConfig -> transformer_config() (CLI/serving reach)
+    off = dataclasses.replace(cfg, sparse_decode=False, attn_grid="dense")
+    assert off.transformer_config().sparse_decode is False
+    assert off.transformer_config().attn_grid == "dense"
+
+
+@pytest.mark.slow
+def test_seq4096_axial_trains_and_samples():
+    """End-to-end at seq 4352: one train grad step produces finite grads and
+    a cached sampling roll stays in range — the scenario the compacted
+    kernels + sparse decode exist to make tractable."""
+    from dalle_pytorch_tpu.models import dalle as dalle_mod
+
+    cfg = _seq4096_cfg()
+    tcfg = cfg.transformer_config()
+
+    # sparse decode roll agrees with the full-cache decode at seq 4352
+    tparams = init_transformer(jax.random.PRNGKey(0), tcfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, tcfg.text_len + 3, tcfg.dim))
+    sparse = _decode_roll(tcfg, tparams, x, 3)
+    full = _decode_roll(dataclasses.replace(tcfg, sparse_decode=False),
+                        tparams, x, 3)
+    np.testing.assert_allclose(sparse, full, atol=2e-6, rtol=2e-6)
+
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text = jax.random.randint(jax.random.PRNGKey(1), (1, cfg.text_seq_len),
+                              1, cfg.num_text_tokens)
+    codes = jax.random.randint(jax.random.PRNGKey(2), (1, cfg.image_seq_len),
+                               0, cfg.num_image_tokens)
+
+    loss, grads = jax.value_and_grad(
+        lambda p: dalle_mod.forward(p, cfg, text, codes, return_loss=True)
+    )(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+    from dalle_pytorch_tpu.models.sampling import sample_image_codes
+
+    primer = jax.random.randint(jax.random.PRNGKey(3),
+                                (1, cfg.image_seq_len - 8), 0,
+                                cfg.num_image_tokens)
+    out = np.asarray(sample_image_codes(
+        params, cfg, text, jax.random.PRNGKey(4), primer_codes=primer,
+        prime_len=int(primer.shape[1])))
+    assert out.shape == (1, cfg.image_seq_len)
+    assert (out >= 0).all() and (out < cfg.num_image_tokens).all()
+    np.testing.assert_array_equal(out[:, : primer.shape[1]], np.asarray(primer))

@@ -89,7 +89,7 @@ def main():
         print(json.dumps({"config": vars(args), "error": str(e)[:300]}))
         return
 
-    flops = dalle_step_flops(cfg, batch, n_matmul)
+    flops = dalle_step_flops(cfg, batch, n_matmul, granularity="tile")
     stats = jax.local_devices()[0].memory_stats() or {}
     print(json.dumps({
         "config": vars(args),

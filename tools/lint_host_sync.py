@@ -8,7 +8,9 @@ step.  The observability stack's core promise is "no per-step host sync";
 this lint makes that promise mechanical for the modules meant to keep it:
 
     dalle_pytorch_tpu/ops/               (attention math, masks, sampling)
-    dalle_pytorch_tpu/kernels/           (Pallas flash attention)
+    dalle_pytorch_tpu/kernels/           (Pallas flash attention + the
+                                          sparse_index compacted-grid /
+                                          decode-gather table builders)
     dalle_pytorch_tpu/parallel/train_step.py
     dalle_pytorch_tpu/observability/health.py   (in-graph half; the host
                                                  half lives in health_host.py)
