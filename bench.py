@@ -10,8 +10,12 @@ flash attention; remat).  MFU is FLOPs-per-step / peak-chip-FLOPs;
 vs_baseline is MFU / 0.45, the BASELINE.md target ratio."""
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import sys
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -95,7 +99,9 @@ def _reexec_cpu_degraded() -> None:
     env["_GRAFT_BENCH_DEGRADED"] = "1"
     sys.stdout.flush()
     sys.stderr.flush()
-    proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+    # keep --gate/--baseline/... alive across the degraded re-exec
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env=env)
     sys.exit(proc.returncode)
 
 
@@ -182,14 +188,16 @@ def _arm_init_watchdog(timeout_s: int = 300):
         sys.stdout.flush()
         sys.stderr.flush()
         # execve replaces the whole process, including the thread stuck in
-        # native backend-init code
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+        # native backend-init code; CLI flags survive the swap
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                  env)
 
     threading.Thread(target=watch, daemon=True).start()
     return ready
 
 
-def main():
+def run_bench() -> dict:
     import os
 
     degraded = bool(os.environ.get("_GRAFT_BENCH_DEGRADED"))
@@ -732,8 +740,181 @@ def main():
         }
     if bench_done is not None:
         bench_done.set()
-    print(json.dumps(out))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regression gate (ROADMAP item 5): compare a bench result against the
+# persisted best-known numbers and fail loudly on regression.
+#
+#   python bench.py --gate --update_baseline        # run, gate, persist bests
+#   python bench.py --gate --candidate out.json     # gate a saved result only
+#
+# Per-metric relative tolerances are deliberately loose: these rows time real
+# work on shared machines, and the gate's job is catching the 2x cliffs a
+# bad merge causes, not 10% scheduler noise.  Only metrics present (numeric,
+# non-null) in BOTH the candidate and the same-backend baseline are compared
+# — TPU-only rows silently skip on CPU and vice versa.
+
+GATE_SPECS = {
+    # dotted path in the bench JSON -> (direction, relative tolerance)
+    "proxy_dim2048_depth8.img_tok_per_sec": ("higher", 0.5),
+    "proxy_dim2048_depth8.mfu": ("higher", 0.5),
+    "serving.ttft_p99_s": ("lower", 0.5),
+    "serving.latency_p99_s": ("lower", 0.5),
+    "serving.queue_wait_p99_s": ("lower", 1.0),
+    "serving.images_per_sec_per_chip": ("higher", 0.5),
+    "health_overhead.overhead_frac": ("lower", 1.0),
+    "flagship_1p3b_depth64.mfu": ("higher", 0.15),
+    "gen_seconds_per_image": ("lower", 0.5),
+    "gen_full_pipeline_seconds_per_image": ("lower", 0.5),
+}
+
+
+def _lookup(result: dict, dotted: str):
+    """Numeric value at a dotted path, or None (missing / null / non-dict)."""
+    cur = result
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def gate_compare(candidate: dict, baseline_metrics: dict,
+                 specs=GATE_SPECS) -> dict:
+    """Compare one bench result against a flat {dotted_path: value} baseline.
+
+    Returns {"checked", "regressions", "improvements"}; a metric regresses
+    when it is worse than baseline by more than its relative tolerance."""
+    checked, regressions, improvements = [], [], []
+    for path, (direction, tol) in specs.items():
+        c = _lookup(candidate, path)
+        b = baseline_metrics.get(path)
+        if c is None or b is None or b <= 0:
+            continue
+        ratio = c / b
+        rec = {"metric": path, "candidate": c, "baseline": b,
+               "ratio": round(ratio, 4), "direction": direction,
+               "rel_tol": tol}
+        checked.append(rec)
+        if (ratio < 1.0 - tol) if direction == "higher" else (ratio > 1.0 + tol):
+            regressions.append(rec)
+        elif (ratio > 1.0) if direction == "higher" else (ratio < 1.0):
+            improvements.append(rec)
+    return {"checked": checked, "regressions": regressions,
+            "improvements": improvements}
+
+
+def _best(direction: str, a: float, b: float) -> float:
+    return max(a, b) if direction == "higher" else min(a, b)
+
+
+def load_result(path: str) -> dict:
+    """Parse a saved bench output: last non-empty line is the JSON record
+    (earlier lines may be the serving engine's ledger prints)."""
+    lines = [ln for ln in Path(path).read_text().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty result file")
+    return json.loads(lines[-1])
+
+
+def run_gate(result: dict, baseline_path: str, gate: bool,
+             update: bool) -> int:
+    """Gate `result` against the baseline file; optionally persist bests.
+
+    The baseline file is keyed by backend ({"cpu": {...}, "tpu": {...}}) so a
+    degraded CPU rerun never gates — or clobbers — real TPU numbers.  With
+    `update`, improvements (and newly-seen metrics) merge in best-of style;
+    a regression is NEVER written back.  Returns the process exit code."""
+    backend = result.get("backend", "unknown")
+    baseline_all = {}
+    p = Path(baseline_path)
+    if p.exists():
+        baseline_all = json.loads(p.read_text())
+    entry = baseline_all.get(backend) or {}
+    baseline_metrics = entry.get("metrics") or {}
+
+    cmp = gate_compare(result, baseline_metrics)
+    for rec in cmp["checked"]:
+        tag = ("REGRESSION" if rec in cmp["regressions"]
+               else "improved" if rec in cmp["improvements"] else "ok")
+        print(f"[gate] {rec['metric']}: {rec['candidate']:.6g} vs baseline "
+              f"{rec['baseline']:.6g} (ratio {rec['ratio']}, "
+              f"{rec['direction']}-is-better, tol {rec['rel_tol']}) {tag}",
+              file=sys.stderr)
+    if not baseline_metrics:
+        print(f"[gate] no {backend} baseline at {baseline_path} — "
+              "nothing to compare" + (" (creating one)" if update else
+                                      "; run with --update_baseline"),
+              file=sys.stderr)
+
+    if cmp["regressions"]:
+        from dalle_pytorch_tpu.observability import telemetry as _telemetry
+
+        tele = _telemetry.active()
+        for rec in cmp["regressions"]:
+            if tele is not None:
+                tele.alarm("bench_regression", **rec)
+        print(f"[gate] FAIL: {len(cmp['regressions'])} metric(s) regressed "
+              f"past tolerance", file=sys.stderr)
+
+    if update and not cmp["regressions"]:
+        merged = dict(baseline_metrics)
+        for path, (direction, _tol) in GATE_SPECS.items():
+            c = _lookup(result, path)
+            if c is None:
+                continue
+            prev = merged.get(path)
+            merged[path] = c if prev is None else _best(direction, prev, c)
+        baseline_all[backend] = {"metrics": merged,
+                                 "metric_count": len(merged),
+                                 "source_metric": result.get("metric")}
+        tmp = str(p) + ".tmp"
+        Path(tmp).write_text(json.dumps(baseline_all, indent=2, sort_keys=True) + "\n")
+        os.replace(tmp, str(p))
+        print(f"[gate] baseline updated: {len(merged)} {backend} metric(s) "
+              f"-> {baseline_path}", file=sys.stderr)
+
+    if gate and cmp["regressions"]:
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="DALL-E bench: throughput/MFU/serving rows + regression gate")
+    parser.add_argument("--baseline",
+                        default=str(Path(__file__).resolve().parent
+                                    / "BENCH_BASELINE.json"),
+                        help="best-known-numbers file (JSON, keyed by backend)")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit nonzero if any gated metric regresses past "
+                             "its tolerance vs the baseline")
+    parser.add_argument("--update_baseline", action="store_true",
+                        help="merge this run's improvements into the baseline "
+                             "(best-of per metric; never writes on regression)")
+    parser.add_argument("--candidate", default=None, metavar="PATH",
+                        help="gate a previously-saved bench JSON instead of "
+                             "running the bench")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="also write the result JSON to PATH")
+    args = parser.parse_args(argv)
+
+    if args.candidate:
+        out = load_result(args.candidate)
+    else:
+        out = run_bench()
+        print(json.dumps(out))
+    if args.out:
+        Path(args.out).write_text(json.dumps(out) + "\n")
+    if args.gate or args.update_baseline:
+        return run_gate(out, args.baseline, gate=args.gate,
+                        update=args.update_baseline)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

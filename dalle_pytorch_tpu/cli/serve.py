@@ -16,6 +16,16 @@ bursts synthetic requests into the queue mid-run so admission control can be
 drilled: the service must queue/refuse — never OOM (the paged pool is sized
 up front and the ledger-priced admission gate refuses what will not fit).
 
+Observability: `--slo_ttft_p99/--slo_latency_p99/--slo_images_per_sec/
+--slo_shed_rate` declare service objectives evaluated over sliding windows
+(observability/slo.py) — a sustained breach fires an `slo_burn_rate` alarm
+through the hub, which `--profile_on_alarm N` turns into a rate-limited
+profiler capture; `--status_json PATH` keeps an atomically-rewritten live
+snapshot (the scrape surface for a router); with `--telemetry` every request
+leaves a `kind:"request"` phase-attributed record (tools/serving_report.py
+renders the waterfall) and a stalled poll() dumps thread stacks + request
+phases via the heartbeat (`--telemetry_heartbeat_s`).
+
 Without `--dalle_path` a `--synthetic` random-init model serves (drills and
 smoke tests run without a trained checkpoint)."""
 from __future__ import annotations
@@ -30,6 +40,7 @@ import numpy as np
 from dalle_pytorch_tpu.observability import memory as memory_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.observability.slo import SloMonitor, SloTargets
 from dalle_pytorch_tpu.training import resilience
 
 
@@ -60,6 +71,24 @@ def build_parser():
     eng.add_argument("--max_queue", type=int, default=64)
     eng.add_argument("--headroom_frac", type=float, default=0.92,
                      help="defer admissions above this live-HBM usage fraction")
+    eng.add_argument("--telemetry_every", type=int, default=32,
+                     help="poll iterations per serving telemetry window "
+                          "(serving_window events, SLO evaluation, status_json)")
+
+    slo = parser.add_argument_group("slo")
+    slo.add_argument("--slo_ttft_p99", type=float, default=None,
+                     help="p99 time-to-first-token target in seconds; a "
+                          "sustained breach fires an slo_burn_rate alarm")
+    slo.add_argument("--slo_latency_p99", type=float, default=None,
+                     help="p99 end-to-end request latency target in seconds")
+    slo.add_argument("--slo_images_per_sec", type=float, default=None,
+                     help="completed-images/sec floor")
+    slo.add_argument("--slo_shed_rate", type=float, default=None,
+                     help="refused/arrivals ceiling (0..1)")
+    slo.add_argument("--status_json", type=str, default=None,
+                     help="atomically rewritten live-status snapshot (live "
+                          "percentiles, queue depth, pool occupancy, active "
+                          "alarms) at the telemetry-window cadence")
 
     traffic = parser.add_argument_group("traffic")
     traffic.add_argument("--prompts", type=str, default=None,
@@ -78,6 +107,15 @@ def build_parser():
     parser.add_argument("--no_vae", action="store_true",
                         help="skip VAE decode (codes-only serving: bench mode)")
     parser.add_argument("--telemetry", type=str, default=None)
+    parser.add_argument("--telemetry_heartbeat_s", type=float, default=300.0,
+                        help="hang-dump deadline: no poll() completing for "
+                             "this long dumps thread stacks + request-phase "
+                             "state (0 disables; needs --telemetry)")
+    parser.add_argument("--profile_on_alarm", type=int, default=0,
+                        help="capture an N-poll profiler trace when any alarm "
+                             "fires (SLO burn, backpressure, hang); "
+                             "rate-limited like the train CLIs "
+                             "(needs --telemetry)")
     parser.add_argument("--report_json", type=str, default=None)
     parser.add_argument("--inject_fault", type=str, default=None,
                         help="chaos hook, e.g. flood@8:16 (see tools/chaos.py)")
@@ -115,7 +153,20 @@ def main(argv=None):
 
     tele = None
     if args.telemetry:
-        tele = telemetry.configure(args.telemetry, run_name="serve")
+        tele = telemetry.configure(
+            args.telemetry, run_name="serve",
+            heartbeat_s=args.telemetry_heartbeat_s or None)
+
+    capture = None
+    if args.profile_on_alarm and tele is not None:
+        from dalle_pytorch_tpu.observability.capture import TraceTrigger
+
+        capture = TraceTrigger(
+            dir=str(Path(args.telemetry) / "traces"),
+            window_steps=args.profile_on_alarm,
+            recorder=tele.spans,
+        ).install_sigusr2()
+        tele.add_alarm_listener(capture.on_alarm)
 
     injector = None
     if args.inject_fault:
@@ -132,8 +183,30 @@ def main(argv=None):
             num_slots=args.slots, block_size=args.block_size,
             num_blocks=args.num_blocks, max_queue=args.max_queue,
             headroom_frac=args.headroom_frac, filter_thres=args.top_k,
+            telemetry_every=args.telemetry_every,
         ),
     )
+    slo_targets = SloTargets(
+        ttft_p99_s=args.slo_ttft_p99, latency_p99_s=args.slo_latency_p99,
+        images_per_sec_floor=args.slo_images_per_sec,
+        shed_rate_ceiling=args.slo_shed_rate,
+    )
+    monitor = None
+    if slo_targets.any():
+        # alarms route through the hub, so the on-alarm TraceTrigger (and
+        # any other listener) reacts to an SLO burn like any other alarm
+        monitor = SloMonitor(
+            slo_targets,
+            on_alarm=(lambda a: tele.alarm(a.pop("type", "slo_burn_rate"), **a))
+            if tele is not None else None,
+        )
+    if monitor is not None or args.status_json:
+        engine.attach_slo(monitor, status_path=args.status_json)
+    if capture is not None:
+        engine.attach_capture(capture)
+    if tele is not None and tele.heartbeat is not None:
+        # a wedged poll() dumps the engine's request-phase state too
+        tele.heartbeat.context_fn = engine.phase_state
     ledger = engine.memory_ledger()
     print("[serving] paged-pool ledger:")
     print(memory_mod.format_ledger(ledger))
@@ -155,6 +228,9 @@ def main(argv=None):
     finally:
         if injector is not None:
             injector.uninstall()
+        engine.close()  # terminal "deferred" records + final window/status
+        if capture is not None:
+            capture.close()
         if tele is not None:
             tele.flush(fleet=False)
             tele.close()

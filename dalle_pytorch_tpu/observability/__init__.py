@@ -42,10 +42,16 @@ from dalle_pytorch_tpu.observability.memory import (
 from dalle_pytorch_tpu.observability.heartbeat import Heartbeat, thread_stacks
 from dalle_pytorch_tpu.observability.metrics import (
     REGISTRY,
+    HistogramWindow,
     MetricsRegistry,
     counter,
     gauge,
     histogram,
+)
+from dalle_pytorch_tpu.observability.slo import (
+    SloMonitor,
+    SloTargets,
+    write_status_json,
 )
 from dalle_pytorch_tpu.observability.spans import SpanRecorder
 from dalle_pytorch_tpu.observability.telemetry import (
@@ -71,8 +77,11 @@ __all__ = [
     "FlopsCrosscheck",
     "HbmMonitor",
     "Heartbeat",
+    "HistogramWindow",
     "MemoryCrosscheck",
     "MetricsRegistry",
+    "SloMonitor",
+    "SloTargets",
     "SpanRecorder",
     "Telemetry",
     "TraceTrigger",
@@ -101,6 +110,7 @@ __all__ = [
     "step_memory_analysis",
     "step_memory_ledger",
     "write_oom_report",
+    "write_status_json",
     "tap",
     "tap_attention",
     "taps_active",

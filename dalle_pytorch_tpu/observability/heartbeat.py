@@ -42,19 +42,26 @@ def thread_stacks() -> str:
 class Heartbeat:
     def __init__(self, deadline_s: float, dir: Optional[str] = None,
                  recorder=None, registry=None, poll_s: Optional[float] = None,
-                 on_hang=None, process_index: Optional[int] = None):
+                 on_hang=None, process_index: Optional[int] = None,
+                 context_fn=None):
         """`recorder`: a SpanRecorder for last-span context + the JSONL hang
         event; `registry`: a MetricsRegistry for the state snapshot;
         `on_hang(report_text, info)`: optional extra callback;
         `process_index`: stamped into the dump filename and header so a
         multi-process run's hang reports triage from one shared directory
-        (which hosts hung, and at which step each one stopped)."""
+        (which hosts hung, and at which step each one stopped);
+        `context_fn() -> dict`: optional live-state provider rendered into
+        the dump — the serve loop wires the engine's request-phase state
+        here so a wedged poll() shows which phase and which requests were
+        in flight.  Assignable after construction (the engine usually
+        exists only after telemetry is configured)."""
         self.deadline_s = float(deadline_s)
         self.dir = Path(dir) if dir is not None else None
         self.recorder = recorder
         self.registry = registry
         self.on_hang = on_hang
         self.process_index = process_index
+        self.context_fn = context_fn
         self.hangs = 0
         self.last_report: Optional[str] = None
         self._last_beat = time.monotonic()
@@ -120,6 +127,15 @@ class Heartbeat:
             for name, rec in sorted(self.registry.snapshot(reset_window=False).items()):
                 brief = {k: v for k, v in rec.items() if k not in ("log2_buckets",)}
                 lines.append(f"  {name}: {brief}")
+        if self.context_fn is not None:
+            lines.append("")
+            lines.append("--- state context ---")
+            try:
+                ctx = self.context_fn() or {}
+            except Exception as e:  # a broken provider must not eat the dump
+                ctx = {"context_fn_error": repr(e)}
+            for k, v in sorted(ctx.items()):
+                lines.append(f"  {k}: {v}")
         lines.append("")
         lines.append("--- thread stacks ---")
         lines.append(thread_stacks())

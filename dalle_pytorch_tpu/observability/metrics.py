@@ -75,6 +75,34 @@ class Gauge:
         return out
 
 
+def bucket_percentile(buckets: Dict[int, int], count: float, q: float,
+                      lo_clamp: Optional[float] = None,
+                      hi_clamp: Optional[float] = None) -> Optional[float]:
+    """Approximate q-quantile (q in [0, 1]) from log2 buckets (bucket i
+    holds values in [2^(i-1), 2^i)): find the bucket holding the q·count-th
+    sample and interpolate linearly inside its range, clamped to the
+    observed min/max when given.  Worst-case error is the bucket width (a
+    factor of 2).  Shared by the cumulative Histogram percentiles and the
+    sliding-window view HistogramWindow computes over bucket DELTAS."""
+    if not count:
+        return None
+    target = q * count
+    cum = 0
+    for b, c in sorted(buckets.items()):
+        if cum + c >= target:
+            lo = 0.0 if b <= -1074 else 2.0 ** (b - 1)
+            hi = 2.0 ** b
+            frac = (target - cum) / c
+            val = lo + (hi - lo) * frac
+            if lo_clamp is not None:
+                val = max(val, lo_clamp)
+            if hi_clamp is not None:
+                val = min(val, hi_clamp)
+            return val
+        cum += c
+    return hi_clamp
+
+
 class Histogram:
     """Streaming distribution: count/total/min/max plus log2-bucket counts
     (bucket i holds values in [2^(i-1), 2^i) seconds/units) — enough for a
@@ -108,36 +136,73 @@ class Histogram:
         return self.total / self.count if self.count else None
 
     def percentile(self, q: float) -> Optional[float]:
-        """Approximate q-quantile (q in [0, 1]) from the log2 buckets: find
-        the bucket holding the q·count-th sample and interpolate linearly
-        inside its [2^(i-1), 2^i) range, clamped to the observed min/max.
-        Worst-case error is the bucket width (a factor of 2) — plenty for
-        the latency tables health_report/telemetry_report render."""
-        if not self.count:
-            return None
-        target = q * self.count
-        cum = 0
-        for b, c in sorted(self._buckets.items()):
-            if cum + c >= target:
-                lo = 0.0 if b <= -1074 else 2.0 ** (b - 1)
-                hi = 2.0 ** b
-                frac = (target - cum) / c
-                val = lo + (hi - lo) * frac
-                if self.min is not None:
-                    val = max(val, self.min)
-                if self.max is not None:
-                    val = min(val, self.max)
-                return val
-            cum += c
-        return self.max
+        """Approximate q-quantile over ALL observations so far (see
+        bucket_percentile); the latency tables health_report /
+        telemetry_report render use this."""
+        with self._lock:
+            return bucket_percentile(self._buckets, self.count, q,
+                                     lo_clamp=self.min, hi_clamp=self.max)
+
+    def state(self) -> Dict[str, Any]:
+        """Cumulative snapshot a HistogramWindow diffs against: monotone
+        count/total and a copy of the bucket counts."""
+        with self._lock:
+            return {"count": self.count, "total": self.total,
+                    "min": self.min, "max": self.max,
+                    "buckets": dict(self._buckets)}
 
     def _snapshot(self, reset_window: bool) -> Dict[str, Any]:
+        # registry.snapshot() already holds the shared (non-reentrant)
+        # instrument lock — go straight to the unlocked percentile core,
+        # NOT self.percentile(), which would self-deadlock
+        def pct(q):
+            return bucket_percentile(self._buckets, self.count, q,
+                                     lo_clamp=self.min, hi_clamp=self.max)
+
         out = {"count": self.count, "total": self.total, "mean": self.mean,
                "min": self.min, "max": self.max,
-               "p50": self.percentile(0.5),
-               "p95": self.percentile(0.95),
-               "p99": self.percentile(0.99),
+               "p50": pct(0.5), "p95": pct(0.95), "p99": pct(0.99),
                "log2_buckets": {str(k): v for k, v in sorted(self._buckets.items())}}
+        return out
+
+
+class HistogramWindow:
+    """Sliding-window percentile view over a Histogram, independent of the
+    registry's flush cadence.
+
+    `registry.flush_to` resets the Counter/Gauge windows, so anything that
+    wants its OWN window (the SLO monitor's burn-rate math) cannot piggyback
+    on snapshot deltas.  This helper keeps a private cumulative snapshot and,
+    on each `advance()`, diffs the histogram's monotone bucket counts against
+    it — yielding count/mean/percentiles of exactly the observations that
+    landed since the previous `advance()`.  Bucket counts only ever grow, so
+    the diff is race-free against concurrent `observe()` calls (an
+    observation lands in either this window or the next, never neither)."""
+
+    __slots__ = ("hist", "_prev")
+
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+        self._prev = hist.state()
+
+    def advance(self) -> Dict[str, Any]:
+        cur = self.hist.state()
+        prev, self._prev = self._prev, cur
+        count = cur["count"] - prev["count"]
+        total = cur["total"] - prev["total"]
+        buckets = {}
+        for b, c in cur["buckets"].items():
+            d = c - prev["buckets"].get(b, 0)
+            if d > 0:
+                buckets[b] = d
+        # cumulative min/max bound (not equal) the window extrema; still
+        # valid clamps since window observations are a subset of all
+        out = {"count": count, "total": total,
+               "mean": total / count if count else None}
+        for label, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            out[label] = bucket_percentile(buckets, count, q,
+                                           lo_clamp=cur["min"],
+                                           hi_clamp=cur["max"])
         return out
 
 

@@ -1,0 +1,251 @@
+"""Streaming SLO monitor for the serving engine.
+
+Declared service objectives (p99 TTFT, p99 end-to-end latency, an
+images/sec floor, a shed-rate ceiling) evaluated continuously over
+sliding windows of the metrics the engine already publishes — no second
+measurement path.  Each `observe()` call closes one window: the TTFT and
+latency histograms are diffed via `HistogramWindow` (delta percentiles,
+independent of the registry's flush cadence), the completed/refused/
+submitted counters are diffed directly, and each objective's **burn
+rate** — measured / target, inverted for floors so >1 always means
+"violating" — is appended to a short history.
+
+Alarms are multi-window burn-rate alarms in the SRE mold: an objective
+fires only when BOTH the short-window burn (the latest `short_windows`
+observations) and the long-window burn (the whole `long_windows`
+history) sit above `burn_threshold`, so a single slow request can't
+page but a sustained breach fires within one window.  Episode
+discipline matches `DivergenceMonitor`/`HbmMonitor`: one alarm per
+episode, re-armed with hysteresis once the short burn recedes below
+`rearm_frac * burn_threshold`, and the episode state round-trips
+through `state_dict()`/`load_state_dict()` so a restarted server does
+not re-page for the breach it was already paged for.
+
+The alarm payload goes to `on_alarm` (wired to the telemetry hub by
+cli/serve.py, where the existing `TraceTrigger` listener turns it into
+a rate-limited profiler capture).  `write_status_json` is the atomic
+scrape surface (tmp + rename) a future multi-replica router reads.
+
+Host-side by construction: this module never imports jax and only does
+dict/float arithmetic — it runs on the engine's poll thread at the
+telemetry-window cadence, never inside a jit.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability.metrics import HistogramWindow
+
+# metric names the serving engine publishes (engine.py is the writer)
+_TTFT_HIST = "serving/ttft_s"
+_LATENCY_HIST = "serving/request_s"
+_COMPLETED = "serving/completed"
+_REFUSED = "serving/refused"
+_SUBMITTED = "serving/submitted"
+
+
+@dataclasses.dataclass(frozen=True)
+class SloTargets:
+    """Declared objectives; None disables that objective."""
+
+    ttft_p99_s: Optional[float] = None
+    latency_p99_s: Optional[float] = None
+    images_per_sec_floor: Optional[float] = None
+    shed_rate_ceiling: Optional[float] = None
+
+    def declared(self) -> Dict[str, float]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    def any(self) -> bool:
+        return bool(self.declared())
+
+
+class SloMonitor:
+    """Windowed burn-rate evaluation of `SloTargets` (see module docs)."""
+
+    def __init__(
+        self,
+        targets: SloTargets,
+        *,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        on_alarm: Optional[Callable[[Dict[str, Any]], None]] = None,
+        short_windows: int = 1,
+        long_windows: int = 6,
+        burn_threshold: float = 1.0,
+        rearm_frac: float = 0.9,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        assert 1 <= short_windows <= long_windows
+        self.targets = targets
+        self.registry = registry if registry is not None else obs_metrics.REGISTRY
+        self.on_alarm = on_alarm
+        self.short_windows = short_windows
+        self.long_windows = long_windows
+        self.burn_threshold = burn_threshold
+        self.rearm_frac = rearm_frac
+        self._clock = clock
+        self._ttft_win = HistogramWindow(self.registry.histogram(_TTFT_HIST))
+        self._lat_win = HistogramWindow(self.registry.histogram(_LATENCY_HIST))
+        self._prev_counts = self._read_counts()
+        self._last_t: Optional[float] = None
+        self._history: Dict[str, Deque[float]] = {}
+        self._alarmed: set = set()
+        self.alarms_total = 0
+        self.last_record: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------- plumbing
+    def _read_counts(self) -> Dict[str, float]:
+        return {name: self.registry.counter(name).value
+                for name in (_COMPLETED, _REFUSED, _SUBMITTED)}
+
+    def _burn_history(self, name: str) -> Deque[float]:
+        h = self._history.get(name)
+        if h is None:
+            h = self._history[name] = collections.deque(maxlen=self.long_windows)
+        return h
+
+    # ------------------------------------------------------------- evaluate
+    def observe(self, iteration: int = 0) -> Dict[str, Any]:
+        """Close one window, update burn histories, fire/re-arm alarms.
+        Returns the window record (also kept as `last_record`)."""
+        now = self._clock()
+        elapsed = None if self._last_t is None else now - self._last_t
+        self._last_t = now
+
+        ttft = self._ttft_win.advance()
+        lat = self._lat_win.advance()
+        counts = self._read_counts()
+        deltas = {k: counts[k] - self._prev_counts[k] for k in counts}
+        self._prev_counts = counts
+        arrivals = deltas[_SUBMITTED] + deltas[_REFUSED]
+
+        # measured value per objective; None = window has no signal for it
+        measured: Dict[str, Optional[float]] = {}
+        t = self.targets
+        if t.ttft_p99_s is not None:
+            measured["ttft_p99"] = ttft["p99"] if ttft["count"] else None
+        if t.latency_p99_s is not None:
+            measured["latency_p99"] = lat["p99"] if lat["count"] else None
+        if t.images_per_sec_floor is not None:
+            if elapsed and elapsed > 0 and (arrivals or deltas[_COMPLETED]):
+                measured["images_per_sec"] = deltas[_COMPLETED] / elapsed
+            else:
+                measured["images_per_sec"] = None
+        if t.shed_rate_ceiling is not None:
+            measured["shed_rate"] = (
+                deltas[_REFUSED] / arrivals if arrivals else None)
+
+        target_of = {
+            "ttft_p99": t.ttft_p99_s,
+            "latency_p99": t.latency_p99_s,
+            "images_per_sec": t.images_per_sec_floor,
+            "shed_rate": t.shed_rate_ceiling,
+        }
+        burns: Dict[str, Dict[str, Any]] = {}
+        fired: List[Dict[str, Any]] = []
+        for name, m in measured.items():
+            if m is None:
+                continue  # an empty window neither burns nor heals
+            tgt = target_of[name]
+            if name == "images_per_sec":
+                burn = tgt / max(m, 1e-9)  # a floor: burn>1 means too slow
+            else:
+                burn = m / max(tgt, 1e-9)
+            hist = self._burn_history(name)
+            hist.append(burn)
+            short = sum(list(hist)[-self.short_windows:]) / min(
+                len(hist), self.short_windows)
+            long = sum(hist) / len(hist)
+            self.registry.gauge(f"slo/burn_{name}").set(burn)
+            burns[name] = {"burn": burn, "short": short, "long": long,
+                           "target": tgt, "measured": m}
+            if short >= self.burn_threshold and long >= self.burn_threshold:
+                if name not in self._alarmed:
+                    self._alarmed.add(name)
+                    self.alarms_total += 1
+                    self.registry.counter("slo/alarms").inc()
+                    payload = {
+                        "type": "slo_burn_rate", "slo": name,
+                        "target": tgt, "measured": m,
+                        "burn_short": short, "burn_long": long,
+                        "iter": iteration,
+                    }
+                    fired.append(payload)
+                    if self.on_alarm is not None:
+                        self.on_alarm(dict(payload))
+            elif short < self.rearm_frac * self.burn_threshold:
+                self._alarmed.discard(name)  # episode over; next breach pages
+
+        rec = {
+            "iter": iteration, "elapsed_s": elapsed,
+            "ttft": ttft, "latency": lat,
+            "completed": deltas[_COMPLETED], "refused": deltas[_REFUSED],
+            "submitted": deltas[_SUBMITTED],
+            "burns": burns,
+            "active_alarms": sorted(self._alarmed),
+            "fired": [f["slo"] for f in fired],
+        }
+        self.last_record = rec
+        return rec
+
+    # --------------------------------------------------------------- state
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "alarmed": sorted(self._alarmed),
+            "history": {k: list(v) for k, v in self._history.items()},
+            "alarms_total": self.alarms_total,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._alarmed = set(state.get("alarmed", ()))
+        self._history = {
+            k: collections.deque(v, maxlen=self.long_windows)
+            for k, v in state.get("history", {}).items()
+        }
+        self.alarms_total = state.get("alarms_total", 0)
+
+    # -------------------------------------------------------------- status
+    def status(self) -> Dict[str, Any]:
+        """The scrape payload: declared targets, live cumulative
+        percentiles, the latest window's burns, and the active episodes."""
+        ttft_h = self.registry.histogram(_TTFT_HIST)
+        lat_h = self.registry.histogram(_LATENCY_HIST)
+        rec = self.last_record or {}
+        return {
+            "targets": self.targets.declared(),
+            "live": {
+                "ttft_p50_s": ttft_h.percentile(0.5),
+                "ttft_p99_s": ttft_h.percentile(0.99),
+                "latency_p50_s": lat_h.percentile(0.5),
+                "latency_p99_s": lat_h.percentile(0.99),
+                "completed": self.registry.counter(_COMPLETED).value,
+                "refused": self.registry.counter(_REFUSED).value,
+                "submitted": self.registry.counter(_SUBMITTED).value,
+            },
+            "window": {k: rec.get(k) for k in
+                       ("iter", "elapsed_s", "completed", "refused",
+                        "submitted")},
+            "burns": {k: {"short": v["short"], "long": v["long"]}
+                      for k, v in rec.get("burns", {}).items()},
+            "active_alarms": sorted(self._alarmed),
+            "alarms_total": self.alarms_total,
+        }
+
+
+def write_status_json(path: str, payload: Dict[str, Any]) -> None:
+    """Atomic snapshot write: tmp file in the same directory + os.replace,
+    so a concurrent scraper never reads a torn JSON document."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    os.replace(tmp, path)

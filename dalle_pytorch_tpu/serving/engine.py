@@ -22,6 +22,17 @@ inside the one fused step.
 Host work here is deliberate and synchronizes only at admission (TTFT needs
 the first token to exist) and eviction (pulling a finished slot's codes);
 the steady-state decode loop dispatches asynchronously.
+
+Observability: every request leaves exactly one `kind:"request"` JSONL
+record — outcome completed/shed/deferred plus per-phase wall-seconds
+(queue_wait, admission, prefill, decode, evict, vae_decode) that sum to its
+latency — and each poll() iteration accumulates admit/dispatch/evict phase
+windows published as `serving/phase_*` gauges (the serving mirror of the
+train loop's data_wait/dispatch/block split) together with a goodput gauge
+(lane-tokens actually decoded vs the ideal slots × steps).  All of it is
+`time.monotonic()` bookkeeping on values the engine already holds on the
+host: telemetry-off poll() performs ZERO additional device syncs
+(tools/lint_host_sync.py keeps that mechanical).
 """
 from __future__ import annotations
 
@@ -132,6 +143,17 @@ class GenerationEngine:
         self._iter = 0
         self._warm_decode = False
         self._flood_rng = np.random.RandomState(0)
+        # observability attachments (all optional; telemetry-off poll() runs
+        # the identical device schedule with only time.monotonic bookkeeping)
+        self._slo = None            # observability.slo.SloMonitor
+        self._status_path: Optional[str] = None
+        self._capture = None        # observability.capture.TraceTrigger
+        self._phase = "idle"        # live poll phase, for hang-dump context
+        self._phase_acc = {"admit": 0.0, "dispatch": 0.0,
+                           "block": 0.0, "evict": 0.0}
+        self._win_decode_steps = 0
+        self._win_lane_tokens = 0
+        self._win_t = time.monotonic()
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_fn = jax.jit(self._decode_step_impl, donate_argnums=donate)
@@ -310,6 +332,8 @@ class GenerationEngine:
         except AdmissionRefused as e:
             obs_metrics.counter("serving/refused").inc()
             self.admission.note_refusal(e.reason)
+            req.phases["queue_wait"] = time.monotonic() - req.arrival_t
+            self._finish_record(req, "shed", reason=e.reason)
             raise
         obs_metrics.counter("serving/submitted").inc()
         return req
@@ -325,8 +349,10 @@ class GenerationEngine:
         req = self._make_request(text, key, temperature, cond_scale, False)
         try:
             self.admission.screen_submit(req)
-        except AdmissionRefused:
+        except AdmissionRefused as e:
             obs_metrics.counter("serving/refused").inc()
+            req.phases["queue_wait"] = time.monotonic() - req.arrival_t
+            self._finish_record(req, "shed", reason=e.reason)
             raise
         waited = False
         while len(self.queue) >= self.queue.max_depth:
@@ -346,15 +372,43 @@ class GenerationEngine:
     def poll(self) -> List[Request]:
         """One engine iteration: flood-fault poll, admissions, one fused
         decode step, evictions.  Returns the requests completed this
-        iteration (codes — and images when a VAE is attached — populated)."""
+        iteration (codes — and images when a VAE is attached — populated).
+
+        Phase attribution: wall time is split into admit (admission checks
+        + prefill, which contains the deliberate TTFT sync), dispatch (the
+        async fused decode step), and evict/block (finished-slot handling;
+        the device pull is counted under "block", mirroring the train
+        loop's data_wait/dispatch/block) — accumulated per telemetry
+        window, all via time.monotonic, no device syncs added."""
         self._iter += 1
+        if self._capture is not None:
+            self._capture.on_step_start(self._iter)
         self._poll_flood()
+        self._phase = "admit"
+        t0 = time.monotonic()
         self._admit_ready()
+        t1 = time.monotonic()
+        self._phase_acc["admit"] += t1 - t0
         if self._inflight:
+            self._phase = "dispatch"
             self._decode_once()
+            self._phase_acc["dispatch"] += time.monotonic() - t1
+        self._phase = "evict"
+        t2 = time.monotonic()
+        blk0 = self._phase_acc["block"]
         done = self._evict_finished()
+        # evict window = host bookkeeping only; the device pull/VAE wait
+        # inside _evict_finished went to the "block" accumulator
+        self._phase_acc["evict"] += (time.monotonic() - t2) - (
+            self._phase_acc["block"] - blk0)
+        self._phase = "idle"
         if self.ecfg.telemetry_every and self._iter % self.ecfg.telemetry_every == 0:
             self._window_event()
+        if self._capture is not None:
+            self._capture.on_step_end(self._iter)
+        tele = telemetry.active()
+        if tele is not None and tele.heartbeat is not None:
+            tele.heartbeat.beat(self._iter)
         return done
 
     def run_until_idle(self, max_iters: Optional[int] = None) -> List[Request]:
@@ -398,6 +452,72 @@ class GenerationEngine:
             f = dict(fields)
             tele.alarm(f.pop("type", "serving_backpressure"), **f)
 
+    # ------------------------------------------------------- observability
+    def attach_slo(self, monitor, status_path: Optional[str] = None) -> None:
+        """Wire an `observability.slo.SloMonitor` (observed once per
+        telemetry window) and/or a `--status_json` path that gets an atomic
+        live snapshot at the same cadence."""
+        self._slo = monitor
+        self._status_path = status_path
+
+    def attach_capture(self, trigger) -> None:
+        """Wire an `observability.capture.TraceTrigger`: poll() becomes its
+        step clock, so an alarm-requested profiler capture starts/stops on
+        the engine thread at poll boundaries (the discipline the trigger
+        requires)."""
+        self._capture = trigger
+
+    def phase_state(self) -> Dict[str, Any]:
+        """Live request-phase snapshot for the heartbeat hang dump: which
+        poll phase the engine died in, and every in-flight request's
+        progress."""
+        return {
+            "iter": self._iter,
+            "phase": self._phase,
+            "queue_depth": len(self.queue),
+            "free_lanes": len(self._free_lanes),
+            "inflight": [
+                {"id": r.id, "codes_done": r.codes_done, "lanes": r.lanes,
+                 "phases": {k: round(v, 3) for k, v in r.phases.items()}}
+                for r in self._inflight
+            ],
+        }
+
+    def _finish_record(self, req: Request, outcome: str, **extra) -> None:
+        """The request's single terminal `kind:"request"` record."""
+        req.outcome = outcome
+        tele = telemetry.active()
+        if tele is None:
+            return
+        tele.spans.write_event(
+            "request", request_id=req.id, outcome=outcome,
+            guided=req.guided, synthetic=req.synthetic,
+            ttft_s=req.ttft_s, latency_s=req.latency_s,
+            decode_tokens=req.codes_done, deferrals=req.deferrals,
+            phases={k: round(v, 6) for k, v in req.phases.items()},
+            **extra,
+        )
+
+    def close(self) -> None:
+        """Account for work the engine will not finish: still-queued and
+        in-flight requests get a terminal outcome "deferred" record (a
+        multi-replica router resubmits those elsewhere), and a final
+        telemetry window is flushed so short runs still report."""
+        now = time.monotonic()
+        while True:
+            req = self.queue.peek()
+            if req is None:
+                break
+            self.queue.pop()
+            req.phases["queue_wait"] = now - req.arrival_t
+            self._finish_record(req, "deferred")
+        for req in self._inflight:
+            if req.admitted_t is not None:
+                req.phases["decode"] = now - req.admitted_t
+            self._finish_record(req, "deferred")
+        self._inflight = []
+        self._window_event()
+
     def _poll_flood(self) -> None:
         n = resilience.take_flood_fault(self._iter)
         if n:
@@ -421,12 +541,15 @@ class GenerationEngine:
                 req, free_lanes=len(self._free_lanes),
                 in_flight=len(self._inflight))
             if reason is not None:
+                req.deferrals += 1  # head-of-queue waited this iteration
                 self.admission.note_deferral(reason)
                 return
             self._do_admit(self.queue.pop())
             self.admission.note_flow()
 
     def _do_admit(self, req: Request) -> None:
+        t_pop = time.monotonic()
+        req.phases["queue_wait"] = t_pop - req.arrival_t
         lanes = [self._free_lanes.pop(0) for _ in range(req.lanes_needed)]
         req.lanes = lanes
         tables = np.stack([
@@ -440,6 +563,8 @@ class GenerationEngine:
         text = jnp.asarray(req.text[None], jnp.int32)
         admit_fn = self._admit_fn_for(req.cond_scale, len(lanes))
         lane_idx = jnp.asarray(lanes, jnp.int32)
+        t_dispatch = time.monotonic()
+        req.phases["admission"] = t_dispatch - t_pop
         with self._suspend_compiles():
             self._state = admit_fn(
                 self.params, self._state, text, k0,
@@ -479,6 +604,7 @@ class GenerationEngine:
         now = time.monotonic()
         req.admitted_t = now
         req.ttft_s = now - req.arrival_t
+        req.phases["prefill"] = now - t_dispatch
         obs_metrics.counter("serving/admitted").inc()
         obs_metrics.histogram("serving/ttft_s").observe(req.ttft_s)
         obs_metrics.gauge("serving/active_lanes").set(
@@ -493,6 +619,8 @@ class GenerationEngine:
         self._warm_decode = True
         obs_metrics.counter("serving/decode_steps").inc()
         obs_metrics.counter("serving/decode_lane_tokens").inc(len(self._inflight))
+        self._win_decode_steps += 1
+        self._win_lane_tokens += len(self._inflight)
         for req in self._inflight:
             req.codes_done += 1
 
@@ -500,10 +628,14 @@ class GenerationEngine:
         done = [r for r in self._inflight if r.codes_done >= self.n_gen]
         if not done:
             return done
+        t_evict = time.monotonic()
         self._inflight = [r for r in self._inflight if r.codes_done < self.n_gen]
         all_lanes: List[int] = []
         for req in done:
+            req.phases["decode"] = t_evict - req.admitted_t
+            t_pull = time.monotonic()
             req.codes = np.asarray(self._state["codes"][req.lanes[0]])  # host-sync-ok: pulling the finished slot's codes
+            self._phase_acc["block"] += time.monotonic() - t_pull
             for i in range(len(req.lanes)):
                 self.pool.free_table((req.id << 1) | i)
             all_lanes.extend(req.lanes)
@@ -518,24 +650,25 @@ class GenerationEngine:
             offsets=st["offsets"].at[li].set(0),
             img_prev=st["img_prev"].at[li].set(0),
         )
-        tele = telemetry.active()
         for req in done:
             if self._vae_decode is not None:
                 t0 = time.perf_counter()
                 images = self._vae_decode(req.codes[None])
                 jax.block_until_ready(images)  # host-sync-ok: completion boundary
-                obs_metrics.histogram("gen/vae_decode_s").observe(
-                    time.perf_counter() - t0)
+                vae_s = time.perf_counter() - t0
+                obs_metrics.histogram("gen/vae_decode_s").observe(vae_s)
                 req.images = np.asarray(images)  # host-sync-ok: delivering the result
+                req.phases["vae_decode"] = vae_s
+                self._phase_acc["block"] += vae_s
                 req.latency_s = time.monotonic() - req.arrival_t
+            # phases must sum to the latency (reports and the flood drill
+            # rely on it): the residual — codes pull, table frees, waiting
+            # behind batch peers' eviction/VAE work — is evict time
+            req.phases["evict"] = max(
+                req.latency_s - sum(req.phases.values()), 0.0)
             obs_metrics.counter("serving/completed").inc()
             obs_metrics.histogram("serving/request_s").observe(req.latency_s)
-            if tele is not None:
-                tele.spans.write_event(
-                    "serving_request", request_id=req.id, ttft_s=req.ttft_s,
-                    latency_s=req.latency_s, guided=req.guided,
-                    synthetic=req.synthetic,
-                )
+            self._finish_record(req, "completed")
         obs_metrics.gauge("serving/active_lanes").set(
             self.ecfg.num_slots - len(self._free_lanes))
         obs_metrics.gauge("serving/pool_occupancy_frac").set(self.pool.occupancy_frac)
@@ -543,16 +676,58 @@ class GenerationEngine:
         return done
 
     def _window_event(self) -> None:
+        """Close one telemetry window: publish the poll-phase split and the
+        goodput gauge, emit the serving_window event (when telemetry is on),
+        run the SLO monitor, and refresh the status_json scrape file."""
+        now = time.monotonic()
+        elapsed = max(now - self._win_t, 1e-9)
+        steps = self._win_decode_steps
+        lane_tokens = self._win_lane_tokens
+        ideal = steps * self.ecfg.num_slots
+        # goodput: lane-tokens actually decoded vs every slot busy every step
+        goodput = lane_tokens / ideal if ideal else None
+        phases = {k: round(v, 6) for k, v in self._phase_acc.items()}
+        for k, v in self._phase_acc.items():
+            obs_metrics.gauge(f"serving/phase_{k}_s").set(v)
+        if goodput is not None:
+            obs_metrics.gauge("serving/goodput_frac").set(goodput)
+        obs_metrics.gauge("serving/lane_tokens_per_s").set(lane_tokens / elapsed)
+        self._phase_acc = {k: 0.0 for k in self._phase_acc}
+        self._win_decode_steps = 0
+        self._win_lane_tokens = 0
+        self._win_t = now
         tele = telemetry.active()
-        if tele is None:
-            return
-        tele.spans.write_event(
-            "serving_window", iter=self._iter,
-            queue_depth=len(self.queue),
-            active_lanes=self.ecfg.num_slots - len(self._free_lanes),
-            pool_occupancy_frac=self.pool.occupancy_frac,
-            pool_free_blocks=self.pool.free_blocks,
-        )
+        if tele is not None:
+            tele.spans.write_event(
+                "serving_window", iter=self._iter,
+                queue_depth=len(self.queue),
+                active_lanes=self.ecfg.num_slots - len(self._free_lanes),
+                pool_occupancy_frac=self.pool.occupancy_frac,
+                pool_free_blocks=self.pool.free_blocks,
+                phase_s=phases, goodput_frac=goodput,
+                lane_tokens_per_s=lane_tokens / elapsed,
+                decode_steps=steps,
+            )
+        if self._slo is not None:
+            rec = self._slo.observe(self._iter)
+            if tele is not None and rec is not None:
+                tele.spans.write_event("slo_window", **rec)
+        if self._status_path:
+            self._write_status()
+
+    def _write_status(self) -> None:
+        from dalle_pytorch_tpu.observability.slo import write_status_json
+
+        payload: Dict[str, Any] = self._slo.status() if self._slo else {}
+        payload["serving"] = {
+            "iter": self._iter,
+            "queue_depth": len(self.queue),
+            "active_lanes": self.ecfg.num_slots - len(self._free_lanes),
+            "inflight": len(self._inflight),
+            "pool_occupancy_frac": self.pool.occupancy_frac,
+            "pool_free_blocks": self.pool.free_blocks,
+        }
+        write_status_json(self._status_path, payload)
 
     def memory_ledger(self, capacity_bytes: Optional[float] = None):
         """The serving path's HBM ledger: params + the paged pool + the

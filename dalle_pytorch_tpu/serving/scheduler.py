@@ -46,7 +46,13 @@ class Request:
     """One generation request.  `text`: (text_seq_len,) raw token ids;
     `key`: the request's PRNG key (raw uint32 (2,)) — the engine derives the
     exact key stream `sample_image_codes` would, so a request is bit-
-    reproducible against the fused sampler."""
+    reproducible against the fused sampler.
+
+    Lifecycle trace: the engine stamps `phases` (queue_wait / admission /
+    prefill / decode / evict / vae_decode wall-seconds) as the request moves
+    through it and sets `outcome` exactly once — "completed", "shed"
+    (refused at submit), or "deferred" (still queued/in-flight when the
+    engine closed) — then emits one `kind:"request"` telemetry record."""
 
     id: int
     text: np.ndarray
@@ -61,6 +67,10 @@ class Request:
     ttft_s: Optional[float] = None
     latency_s: Optional[float] = None
     synthetic: bool = False
+    # lifecycle trace (engine-owned)
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    deferrals: int = 0
+    outcome: Optional[str] = None
     # results
     codes: Optional[np.ndarray] = None
     images: Optional[np.ndarray] = None

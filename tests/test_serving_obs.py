@@ -1,0 +1,400 @@
+"""Serving observability (PR 11): request lifecycle traces, windowed SLO
+burn-rate alarms, decode-loop phase attribution, and the bench regression
+gate.
+
+The contract under test: every request that enters the engine leaves a
+`kind:"request"` record whose phases sum to its latency, whatever its
+outcome (completed / shed / deferred); the SLO monitor pages once per
+breach episode and re-arms with hysteresis; and none of it adds a host
+sync to the telemetry-off poll loop (the lint proves that mechanically,
+the bit-parity test proves the decode math never noticed).
+"""
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.observability.metrics import (
+    HistogramWindow, MetricsRegistry,
+)
+from dalle_pytorch_tpu.observability.slo import (
+    SloMonitor, SloTargets, write_status_json,
+)
+from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused
+
+from test_serving import base, fused_ref, tiny_cfg  # noqa: F401 — fixtures
+
+
+def _load_spans(path: Path):
+    from telemetry_report import load_records
+
+    return load_records(path)
+
+
+# --------------------------------------------------------------------------
+# request lifecycle records
+
+
+def test_request_records_all_outcomes(base, tmp_path):  # noqa: F811
+    """completed, shed, and deferred requests each leave a request record;
+    completed phases sum exactly to the measured latency."""
+    cfg, params, text = base
+    tele = telemetry.configure(str(tmp_path), run_name="serve",
+                               heartbeat_s=None, watch_compiles=False)
+    try:
+        # shed: a pool too small for one sequence refuses at submit
+        tiny = GenerationEngine(params, cfg,
+                                engine_cfg=EngineConfig(num_slots=2,
+                                                        block_size=4,
+                                                        num_blocks=2))
+        with pytest.raises(AdmissionRefused):
+            tiny.submit(text[0])
+
+        eng = GenerationEngine(params, cfg,
+                               engine_cfg=EngineConfig(num_slots=2,
+                                                       block_size=4,
+                                                       telemetry_every=4))
+        eng.submit(text[0], key=jax.random.PRNGKey(0))
+        done = eng.run_until_idle()
+        assert len(done) == 1
+        # deferred: queued work the server shuts down on
+        eng.submit(text[1], key=jax.random.PRNGKey(1))
+        eng.close()
+    finally:
+        tele.flush(fleet=False)
+        tele.close()
+
+    recs = [r for r in _load_spans(tmp_path / "serve.spans.jsonl")
+            if r.get("kind") == "request"]
+    by_outcome = {}
+    for r in recs:
+        by_outcome.setdefault(r["outcome"], []).append(r)
+    assert set(by_outcome) == {"completed", "shed", "deferred"}
+    assert len(by_outcome["completed"]) == 1
+
+    comp = by_outcome["completed"][0]
+    phases = comp["phases"]
+    for name in ("queue_wait", "admission", "prefill", "decode", "evict"):
+        assert name in phases, f"missing phase {name}"
+    assert comp["latency_s"] == pytest.approx(sum(phases.values()), abs=1e-4)
+    assert comp["decode_tokens"] == cfg.image_seq_len
+    assert comp["request_id"] is not None
+
+    shed = by_outcome["shed"][0]
+    assert shed["reason"] and "queue_wait" in shed["phases"]
+    deferred = by_outcome["deferred"][0]
+    assert "queue_wait" in deferred["phases"]
+
+
+def test_phases_recorded_with_telemetry_off(base):  # noqa: F811
+    """The trace is stamped on the Request object regardless of telemetry —
+    only the JSONL write is gated — and decode output stays bit-exact with
+    the monitor attached (no jax work happens on the bookkeeping path)."""
+    cfg, params, text = base
+    assert telemetry.active() is None
+    reg = MetricsRegistry()
+    eng = GenerationEngine(params, cfg,
+                           engine_cfg=EngineConfig(num_slots=2, block_size=4))
+    eng.attach_slo(SloMonitor(SloTargets(ttft_p99_s=1e-6), registry=reg))
+    keys = [jax.random.PRNGKey(70 + i) for i in range(2)]
+    reqs = eng.generate(text[:2], keys=keys)
+    for i, req in enumerate(reqs):
+        np.testing.assert_array_equal(req.codes[None],
+                                      fused_ref(params, cfg, text[i], keys[i]))
+        assert req.outcome == "completed"
+        assert req.latency_s == pytest.approx(sum(req.phases.values()),
+                                              abs=1e-4)
+
+
+def test_serving_window_phase_gauges_and_status_json(base, tmp_path):  # noqa: F811
+    """serving_window events carry the poll-loop phase split + goodput;
+    slo_window events and the atomic status.json ride the same cadence."""
+    cfg, params, text = base
+    status = tmp_path / "status.json"
+    tele = telemetry.configure(str(tmp_path), run_name="serve",
+                               heartbeat_s=None, watch_compiles=False)
+    try:
+        eng = GenerationEngine(params, cfg,
+                               engine_cfg=EngineConfig(num_slots=2,
+                                                       block_size=4,
+                                                       telemetry_every=4))
+        mon = SloMonitor(
+            SloTargets(ttft_p99_s=1e-6), short_windows=1, long_windows=2,
+            on_alarm=lambda a: tele.alarm(a.pop("type", "slo_burn_rate"), **a))
+        eng.attach_slo(mon, status_path=str(status))
+        eng.generate(text[:2], keys=[jax.random.PRNGKey(80 + i)
+                                     for i in range(2)])
+        eng.close()
+    finally:
+        tele.flush(fleet=False)
+        tele.close()
+
+    recs = _load_spans(tmp_path / "serve.spans.jsonl")
+    windows = [r for r in recs if r.get("kind") == "serving_window"]
+    assert windows
+    w = windows[-1]
+    assert set(w["phase_s"]) == {"admit", "dispatch", "block", "evict"}
+    assert 0.0 <= w["goodput_frac"] <= 1.0
+    assert [r for r in recs if r.get("kind") == "slo_window"]
+    assert [r for r in recs if r.get("kind") == "alarm"
+            and r.get("type") == "slo_burn_rate"]
+
+    doc = json.loads(status.read_text())
+    assert doc["targets"] == {"ttft_p99_s": 1e-6}
+    assert "ttft_p99" in doc["active_alarms"]
+    assert doc["live"]["completed"] >= 2
+    assert doc["serving"]["queue_depth"] == 0
+
+    # the renderer understands the new stream end to end
+    from serving_report import build_report
+
+    out = build_report(recs)
+    assert "phase attribution" in out and "waterfall" in out
+    assert "SLO windows" in out and "SLO burn-rate alarms" in out
+
+
+# --------------------------------------------------------------------------
+# windowed percentiles + burn-rate episodes
+
+
+def test_histogram_window_delta_percentiles():
+    """advance() sees exactly the observations since the previous advance();
+    log2-bucket percentiles are within 2x of the exact value and clamped to
+    the cumulative extrema."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t")
+    win = HistogramWindow(h)
+
+    first = [0.010, 0.011, 0.012, 0.013]
+    for v in first:
+        h.observe(v)
+    d = win.advance()
+    assert d["count"] == len(first)
+    assert d["total"] == pytest.approx(sum(first))
+    assert max(first) / 2 <= d["p99"] <= max(first)
+
+    # empty window: no signal, percentiles None
+    d = win.advance()
+    assert d["count"] == 0 and d["p50"] is None and d["mean"] is None
+
+    # a much slower second window must NOT be averaged with the first
+    second = [1.0, 1.1, 1.2, 1.3]
+    for v in second:
+        h.observe(v)
+    d = win.advance()
+    assert d["count"] == len(second)
+    assert d["p50"] >= 0.5, "window percentile leaked earlier fast samples"
+    assert d["p99"] <= h.max
+
+    # cumulative view still covers everything
+    assert h.count == len(first) + len(second)
+
+
+def test_slo_monitor_fires_once_rearms_and_roundtrips():
+    """A sustained breach pages exactly once; recovery re-arms the episode;
+    a restart that loads state_dict does not re-page mid-episode."""
+    reg = MetricsRegistry()
+    clock = {"t": 0.0}
+    alarms = []
+    mon = SloMonitor(SloTargets(ttft_p99_s=0.1), registry=reg,
+                     on_alarm=alarms.append, short_windows=1, long_windows=3,
+                     clock=lambda: clock["t"])
+    h = reg.histogram("serving/ttft_s")
+    comp = reg.counter("serving/completed")
+
+    def window(ttfts):
+        clock["t"] += 10.0
+        for v in ttfts:
+            h.observe(v)
+            comp.inc()
+        return mon.observe(iteration=int(clock["t"]))
+
+    window([1.0, 1.2])            # burn 10x+: breach
+    assert [a["slo"] for a in alarms] == ["ttft_p99"]
+    assert alarms[0]["burn_short"] >= 1.0 and alarms[0]["measured"] > 0.1
+    window([1.0, 1.2])            # still breaching: same episode, no re-page
+    assert len(alarms) == 1
+    rec = window([0.001, 0.002])  # healthy: episode ends, re-arms
+    assert rec["active_alarms"] == []
+    window([1.0])                 # new breach -> second page
+    assert len(alarms) == 2
+    assert mon.alarms_total == 2
+
+    # restart mid-episode: loaded state remembers the live alarm
+    state = mon.state_dict()
+    mon2 = SloMonitor(SloTargets(ttft_p99_s=0.1), registry=reg,
+                      on_alarm=alarms.append, short_windows=1, long_windows=3,
+                      clock=lambda: clock["t"])
+    mon2.load_state_dict(state)
+    assert mon2.state_dict() == state
+    clock["t"] += 10.0
+    h.observe(1.0)
+    comp.inc()
+    mon2.observe()
+    assert len(alarms) == 2, "restart re-paged for an already-paged episode"
+
+
+def test_slo_monitor_empty_windows_do_not_page():
+    """Windows with no signal neither burn nor heal: an idle server with a
+    live episode keeps it; an idle healthy server never pages."""
+    reg = MetricsRegistry()
+    alarms = []
+    mon = SloMonitor(SloTargets(ttft_p99_s=0.1, shed_rate_ceiling=0.5),
+                     registry=reg, on_alarm=alarms.append,
+                     clock=iter(range(0, 1000, 10)).__next__)
+    for _ in range(5):
+        assert mon.observe()["burns"] == {}
+    assert alarms == [] and mon.state_dict()["alarmed"] == []
+
+
+# --------------------------------------------------------------------------
+# telemetry-off purity + heartbeat context
+
+
+def test_serving_modules_host_sync_clean():
+    """The lint that keeps the poll loop sync-free covers the serving
+    package and the SLO monitor; slo.py never imports jax at all."""
+    from lint_host_sync import lint_paths
+
+    root = Path(__file__).resolve().parents[1]
+    findings = lint_paths(str(root), targets=(
+        "dalle_pytorch_tpu/serving", "dalle_pytorch_tpu/observability/slo.py"))
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+    src = (root / "dalle_pytorch_tpu/observability/slo.py").read_text()
+    assert "import jax" not in src
+
+
+def test_heartbeat_context_fn_in_hang_dump(tmp_path):
+    """A stalled poll loop's hang report includes the engine-state context
+    the serve CLI wires in (which phase, which requests in flight)."""
+    from dalle_pytorch_tpu.observability.heartbeat import Heartbeat
+
+    hb = Heartbeat(deadline_s=0.2, dir=str(tmp_path), poll_s=0.05,
+                   context_fn=lambda: {"phase": "dispatch", "iter": 7,
+                                       "queue_depth": 3})
+    hb.start()
+    try:
+        hb.beat(1)
+        deadline = time.monotonic() + 5.0
+        while hb.hangs == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        hb.stop()
+    assert hb.hangs >= 1
+    dumps = list(tmp_path.glob("hang_*.txt"))
+    assert dumps
+    report = dumps[0].read_text()
+    assert "--- state context ---" in report
+    assert "phase: dispatch" in report and "queue_depth: 3" in report
+
+
+def test_write_status_json_atomic(tmp_path):
+    p = tmp_path / "deep" / "status.json"
+    write_status_json(str(p), {"a": 1})
+    assert json.loads(p.read_text()) == {"a": 1}
+    write_status_json(str(p), {"a": 2})
+    assert json.loads(p.read_text()) == {"a": 2}
+    assert not list(p.parent.glob(".*tmp"))
+
+
+# --------------------------------------------------------------------------
+# bench regression gate
+
+
+def _bench_result(**over):
+    out = {
+        "metric": "img-tokens/sec/chip (CPU smoke)",
+        "backend": "cpu",
+        "proxy_dim2048_depth8": {"img_tok_per_sec": 5000.0, "mfu": 0.0002},
+        "serving": {"ttft_p99_s": 2.0, "latency_p99_s": 4.0,
+                    "queue_wait_p99_s": 0.2,
+                    "images_per_sec_per_chip": 0.8},
+        "health_overhead": {"overhead_frac": 0.3},
+        "gen_seconds_per_image": None,
+    }
+    for k, v in over.items():
+        d, key = k.rsplit(".", 1) if "." in k else (None, k)
+        (out[d] if d else out)[key] = v
+    return out
+
+
+def test_bench_gate_exit_codes(tmp_path):
+    """--gate against a baseline built from the same numbers exits 0; a 2x
+    TTFT regression exits nonzero; improvements merge best-of."""
+    import bench
+
+    baseline = tmp_path / "BENCH_BASELINE.json"
+    cand = tmp_path / "cand.json"
+    cand.write_text("ledger noise line\n" + json.dumps(_bench_result()) + "\n")
+
+    args = ["--candidate", str(cand), "--baseline", str(baseline)]
+    assert bench.main(args + ["--gate", "--update_baseline"]) == 0
+    assert bench.main(args + ["--gate"]) == 0  # self-compare: clean
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_bench_result(**{"serving.ttft_p99_s": 4.0})))
+    assert bench.main(["--candidate", str(bad), "--baseline", str(baseline),
+                       "--gate"]) == 1
+
+    # an improvement passes the gate and becomes the new best-known number
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_bench_result(**{"serving.ttft_p99_s": 1.0})))
+    assert bench.main(["--candidate", str(good), "--baseline", str(baseline),
+                       "--gate", "--update_baseline"]) == 0
+    doc = json.loads(baseline.read_text())
+    assert doc["cpu"]["metrics"]["serving.ttft_p99_s"] == 1.0
+    # ...and a later worse-but-in-tolerance run never regresses the baseline
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_bench_result(**{"serving.ttft_p99_s": 1.4})))
+    assert bench.main(["--candidate", str(ok), "--baseline", str(baseline),
+                       "--gate", "--update_baseline"]) == 0
+    doc = json.loads(baseline.read_text())
+    assert doc["cpu"]["metrics"]["serving.ttft_p99_s"] == 1.0
+
+
+def test_bench_gate_backend_keyed(tmp_path):
+    """A degraded CPU rerun neither gates against nor clobbers TPU numbers."""
+    import bench
+
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps({
+        "tpu": {"metrics": {"flagship_1p3b_depth64.mfu": 0.45}}}))
+    cand = tmp_path / "c.json"
+    cand.write_text(json.dumps(_bench_result()))
+    assert bench.main(["--candidate", str(cand), "--baseline", str(baseline),
+                       "--gate", "--update_baseline"]) == 0
+    doc = json.loads(baseline.read_text())
+    assert doc["tpu"]["metrics"]["flagship_1p3b_depth64.mfu"] == 0.45
+    assert "serving.ttft_p99_s" in doc["cpu"]["metrics"]
+
+
+def test_bench_gate_compare_directions():
+    from bench import gate_compare
+
+    cand = _bench_result(**{"serving.ttft_p99_s": 2.9,
+                            "proxy_dim2048_depth8.img_tok_per_sec": 2600.0})
+    basemetrics = {"serving.ttft_p99_s": 2.0,
+                   "proxy_dim2048_depth8.img_tok_per_sec": 5000.0,
+                   "flagship_1p3b_depth64.mfu": 0.45}  # absent in cand: skip
+    cmp = gate_compare(cand, basemetrics)
+    by = {r["metric"]: r for r in cmp["checked"]}
+    assert set(by) == {"serving.ttft_p99_s",
+                      "proxy_dim2048_depth8.img_tok_per_sec"}
+    # 1.45x slower TTFT is inside the 0.5 tolerance; a 48% throughput drop
+    # is past its 50%... not quite — 2600/5000 = 0.52 survives at tol 0.5
+    assert cmp["regressions"] == []
+    cmp = gate_compare(_bench_result(**{
+        "proxy_dim2048_depth8.img_tok_per_sec": 2400.0}), basemetrics)
+    assert [r["metric"] for r in cmp["regressions"]] == [
+        "proxy_dim2048_depth8.img_tok_per_sec"]

@@ -188,7 +188,12 @@ def flood_drill(requests=4, burst=16, at=2, slots=2, max_queue=4,
     with `--inject_fault flood@AT:BURST` and verify the service DEGRADES —
     every admitted request completes, excess load is queued/refused (counted
     in the SLO report), and the process neither OOMs (exit 77) nor crashes.
-    Returns 0 on success."""
+
+    Observability assertions ride along: the run declares an impossible
+    TTFT SLO so the burn-rate alarm must fire during the flood, exactly ONE
+    rate-limited profiler capture lands, and every arrival (organic + burst)
+    leaves a `kind:"request"` record whose phase durations sum to its
+    latency.  Returns 0 on success."""
     import json
     import subprocess
     import tempfile
@@ -196,6 +201,7 @@ def flood_drill(requests=4, burst=16, at=2, slots=2, max_queue=4,
     cwd = Path(workdir) if workdir else Path(tempfile.mkdtemp(prefix="flood_"))
     cwd.mkdir(parents=True, exist_ok=True)
     report_path = cwd / "flood_report.json"
+    tele_dir = cwd / "tele"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -213,6 +219,12 @@ def flood_drill(requests=4, burst=16, at=2, slots=2, max_queue=4,
          "--slots", str(slots), "--block_size", "8",
          "--max_queue", str(max_queue), "--no_vae",
          "--inject_fault", f"flood@{at}:{burst}",
+         # observability under fire: an impossible TTFT target guarantees
+         # an slo_burn_rate alarm, which the on-alarm trigger must turn
+         # into exactly one (rate-limited) profiler capture
+         "--telemetry", str(tele_dir), "--telemetry_every", "4",
+         "--slo_ttft_p99", "1e-6", "--profile_on_alarm", "2",
+         "--status_json", str(cwd / "status.json"),
          "--report_json", str(report_path)],
         cwd=str(cwd), env=env, capture_output=True, text=True, timeout=timeout,
     )
@@ -244,6 +256,56 @@ def flood_drill(requests=4, burst=16, at=2, slots=2, max_queue=4,
         print("[flood] FAIL: the burst produced no refusals/backpressure — "
               "the drill did not stress admission control")
         return 1
+
+    # --- observability assertions over the telemetry stream ---------------
+    spans_path = tele_dir / "serve.spans.jsonl"
+    records = [json.loads(ln) for ln in spans_path.read_text().splitlines()
+               if ln.strip()]
+    counters = {}
+    for rec in records:
+        if rec.get("kind") == "metrics":
+            for name in ("serving/submitted", "serving/refused"):
+                c = (rec.get("metrics") or {}).get(name)
+                if c and c.get("total") is not None:
+                    counters[name] = c["total"]
+    arrivals = counters.get("serving/submitted", 0) + counters.get(
+        "serving/refused", 0)
+    req_recs = [rec for rec in records if rec.get("kind") == "request"]
+    if len(req_recs) != arrivals or arrivals == 0:
+        print(f"[flood] FAIL: {len(req_recs)} request records != "
+              f"{arrivals:.0f} arrivals — the lifecycle trace lost requests")
+        return 1
+    bad_sums = []
+    for rec in req_recs:
+        if rec.get("outcome") != "completed":
+            continue
+        lat = rec.get("latency_s") or 0.0
+        ssum = sum((rec.get("phases") or {}).values())
+        if abs(ssum - lat) > max(0.05, 0.15 * lat):
+            bad_sums.append((rec.get("request_id"), ssum, lat))
+    if bad_sums:
+        print(f"[flood] FAIL: phase durations do not sum to latency: "
+              f"{bad_sums}")
+        return 1
+    slo_alarms = [rec for rec in records if rec.get("kind") == "alarm"
+                  and rec.get("type") == "slo_burn_rate"]
+    if not slo_alarms:
+        print("[flood] FAIL: the impossible TTFT SLO never fired a "
+              "burn-rate alarm")
+        return 1
+    captures = [rec for rec in records if rec.get("kind") == "trace_capture"
+                and rec.get("action") == "start"]
+    if len(captures) != 1:
+        print(f"[flood] FAIL: expected exactly 1 rate-limited profiler "
+              f"capture, got {len(captures)}")
+        return 1
+    outcomes = {}
+    for rec in req_recs:
+        outcomes[rec.get("outcome")] = outcomes.get(rec.get("outcome"), 0) + 1
+    print(f"[flood] obs OK: {len(req_recs)} request records cover all "
+          f"{arrivals:.0f} arrivals {outcomes}; phases sum to latency; "
+          f"{len(slo_alarms)} slo_burn_rate alarm(s); exactly 1 profiler "
+          f"capture ({captures[0].get('reason')})")
     print(f"[flood] OK: {organic_done} organic completed + {organic_refused} "
           f"organic refused (all {requests} accounted for); "
           f"{report.get('synthetic_completed', 0)} of the burst served, "

@@ -71,6 +71,10 @@ JIT_PURE = (
     # scheduler's deliberate host work — TTFT blocking, pulling finished
     # codes, CLI scalars — is waived line-by-line
     "dalle_pytorch_tpu/serving",
+    # the SLO monitor runs on the engine's poll thread at window cadence —
+    # it must stay pure host arithmetic over the metrics registry (it never
+    # imports jax; this keeps it that way mechanically)
+    "dalle_pytorch_tpu/observability/slo.py",
 )
 
 WAIVER = "host-sync-ok"

@@ -84,6 +84,13 @@ class PoissonLoadGen:
                elapsed_s: float) -> Dict[str, Any]:
         ttfts = np.asarray([r.ttft_s for r in completed if r.ttft_s is not None])
         lats = np.asarray([r.latency_s for r in completed if r.latency_s is not None])
+        # queue_wait comes from the engine's per-request phase trace: the
+        # time TTFT spends just WAITING (queue-full backpressure is invisible
+        # inside raw TTFT; this makes it a first-class SLO column)
+        qwaits = np.asarray([
+            r.phases["queue_wait"] for r in completed
+            if getattr(r, "phases", None) and "queue_wait" in r.phases
+        ])
 
         def pct(a, q):
             return float(np.percentile(a, q)) if a.size else None
@@ -96,6 +103,8 @@ class PoissonLoadGen:
             "elapsed_s": round(elapsed_s, 4),
             "ttft_p50_s": pct(ttfts, 50),
             "ttft_p99_s": pct(ttfts, 99),
+            "queue_wait_p50_s": pct(qwaits, 50),
+            "queue_wait_p99_s": pct(qwaits, 99),
             "latency_p50_s": pct(lats, 50),
             "latency_p99_s": pct(lats, 99),
             # the engine runs on ONE device; normalize per serving chip

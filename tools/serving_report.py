@@ -4,15 +4,21 @@
     python tools/serving_report.py /tmp/tele/serve.spans.jsonl
     python tools/serving_report.py /tmp/tele           # picks *.spans.jsonl
 
-Three sections, all from the stream serving/engine.py writes:
+Sections, all from the stream serving/engine.py writes:
 
-* **requests** (`kind:"serving_request"`) — completion count, exact p50/p99
-  time-to-first-token and request latency, guided/synthetic split, and
-  throughput over the record span;
-* **engine windows** (`kind:"serving_window"`) — queue depth, active lanes,
-  and paged-pool occupancy over time (the saturation timeline);
-* **backpressure** — `serving_backpressure` alarms plus the refusal /
-  deferral counters from metric snapshots.
+* **requests** (`kind:"request"`; legacy `serving_request` accepted) —
+  outcome counts (completed/shed/deferred), exact p50/p99 time-to-first-
+  token and request latency, guided/synthetic split, throughput;
+* **phase attribution** — mean/p50/p99 wall-seconds per lifecycle phase
+  (queue_wait, admission, prefill, decode, evict, vae_decode) and each
+  phase's share of total latency (the serving analogue of
+  telemetry_report.py's step table);
+* **waterfall** — one scaled bar per request showing where its latency
+  went;
+* **engine windows** (`kind:"serving_window"`) — queue depth, lanes, pool
+  occupancy, goodput, and the poll-loop admit/dispatch/block/evict split;
+* **SLO windows** (`kind:"slo_window"`) + burn-rate / backpressure alarms
+  and the refusal/deferral counters from metric snapshots.
 
 Pure stdlib; works on a partially-written file from a live run."""
 from __future__ import annotations
@@ -39,52 +45,136 @@ def _ms(v) -> str:
     return f"{v * 1e3:.1f}ms" if v is not None else "-"
 
 
+# lifecycle phase order + the glyph each gets in the waterfall bars
+PHASES = (("queue_wait", "."), ("admission", "a"), ("prefill", "p"),
+          ("decode", "#"), ("evict", "e"), ("vae_decode", "v"))
+
+
+def _phase_table(done: List[Dict[str, Any]]) -> List[str]:
+    """Mean/p50/p99 per phase + share of summed latency."""
+    out = ["", "phase attribution (completed requests):",
+           "  phase        mean      p50      p99   share"]
+    total = sum(r.get("latency_s") or 0.0 for r in done) or 1e-12
+    for name, _ in PHASES:
+        vals = [r["phases"][name] for r in done
+                if (r.get("phases") or {}).get(name) is not None]
+        if not vals:
+            continue
+        out.append(
+            f"  {name:<10} {_ms(sum(vals) / len(vals)):>8} "
+            f"{_ms(_pct(vals, 0.50)):>8} {_ms(_pct(vals, 0.99)):>8} "
+            f"{sum(vals) / total * 100:>6.1f}%")
+    return out
+
+
+def _waterfall(done: List[Dict[str, Any]], max_rows: int,
+               width: int = 40) -> List[str]:
+    """One bar per request, each phase's glyph run scaled to its share."""
+    out = ["", f"waterfall (last {min(len(done), max_rows)} of {len(done)}; "
+               f"legend: {' '.join(f'{g}={n}' for n, g in PHASES)}):"]
+    for r in done[-max_rows:]:
+        lat = r.get("latency_s")
+        phases = r.get("phases") or {}
+        if not lat or not phases:
+            continue
+        bar = ""
+        for name, glyph in PHASES:
+            n = int(round((phases.get(name) or 0.0) / lat * width))
+            bar += glyph * n
+        out.append(f"  req {r.get('request_id', '?'):>4} "
+                   f"{_ms(lat):>10}  |{bar[:width]:<{width}}|")
+    return out
+
+
 def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
-    reqs = [r for r in records if r.get("kind") == "serving_request"]
+    reqs = [r for r in records
+            if r.get("kind") in ("request", "serving_request")]
     windows = [r for r in records if r.get("kind") == "serving_window"]
+    slo_windows = [r for r in records if r.get("kind") == "slo_window"]
     alarms = [r for r in records if r.get("kind") == "alarm"
               and r.get("type") == "serving_backpressure"]
+    slo_alarms = [r for r in records if r.get("kind") == "alarm"
+                  and r.get("type") == "slo_burn_rate"]
 
     out: List[str] = []
+    # legacy serving_request records carry no outcome: they were only ever
+    # written at completion
+    done = [r for r in reqs if r.get("outcome", "completed") == "completed"]
+    shed = [r for r in reqs if r.get("outcome") == "shed"]
+    deferred = [r for r in reqs if r.get("outcome") == "deferred"]
     if reqs:
-        ttfts = [r["ttft_s"] for r in reqs if r.get("ttft_s") is not None]
-        lats = [r["latency_s"] for r in reqs if r.get("latency_s") is not None]
-        guided = sum(1 for r in reqs if r.get("guided"))
-        synth = sum(1 for r in reqs if r.get("synthetic"))
+        ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
+        lats = [r["latency_s"] for r in done if r.get("latency_s") is not None]
+        guided = sum(1 for r in done if r.get("guided"))
+        synth = sum(1 for r in done if r.get("synthetic"))
         span_s = None
-        ts = [r.get("ts") for r in reqs if r.get("ts") is not None]
+        ts = [r.get("ts") for r in done if r.get("ts") is not None]
         if len(ts) >= 2:
             span_s = max(ts) - min(ts)
-        out.append(f"requests: {len(reqs)} completed "
-                   f"({guided} guided, {synth} synthetic)")
+        out.append(f"requests: {len(done)} completed "
+                   f"({guided} guided, {synth} synthetic)"
+                   + (f", {len(shed)} shed" if shed else "")
+                   + (f", {len(deferred)} deferred" if deferred else ""))
         out.append(f"  TTFT     p50 {_ms(_pct(ttfts, 0.50))}   "
                    f"p99 {_ms(_pct(ttfts, 0.99))}")
         out.append(f"  latency  p50 {_ms(_pct(lats, 0.50))}   "
                    f"p99 {_ms(_pct(lats, 0.99))}")
         if span_s and span_s > 0:
             out.append(f"  throughput over record span: "
-                       f"{len(reqs) / span_s:.3f} images/sec/chip")
+                       f"{len(done) / span_s:.3f} images/sec/chip")
+        traced = [r for r in done if r.get("phases")]
+        if traced:
+            out.extend(_phase_table(traced))
+            out.extend(_waterfall(traced, max_rows))
     else:
-        out.append("no serving_request records — did the run route through "
+        out.append("no request records — did the run route through "
                    "the engine with telemetry active?")
 
     if windows:
         out.append("")
         out.append(f"engine windows ({len(windows)}; last {max_rows}):")
-        out.append("  iter     queue  lanes  pool_occ  free_blocks")
+        out.append("  iter     queue  lanes  pool_occ  free_blocks  goodput"
+                   "  admit/dispatch/block/evict")
         for w in windows[-max_rows:]:
+            g = w.get("goodput_frac")
+            ph = w.get("phase_s") or {}
+            split = "/".join(
+                _ms(ph.get(k)) if ph.get(k) is not None else "-"
+                for k in ("admit", "dispatch", "block", "evict")) if ph else "-"
             out.append(
                 f"  {w.get('iter', '-'):>6} {w.get('queue_depth', 0):>6} "
                 f"{w.get('active_lanes', 0):>6} "
                 f"{(w.get('pool_occupancy_frac') or 0) * 100:>7.1f}% "
-                f"{w.get('pool_free_blocks', '-'):>10}")
+                f"{w.get('pool_free_blocks', '-'):>10} "
+                f"{f'{g * 100:.0f}%' if g is not None else '-':>8}  {split}")
+
+    if slo_windows:
+        out.append("")
+        out.append(f"SLO windows ({len(slo_windows)}; last {max_rows}):")
+        out.append("  iter   completed  refused  burns")
+        for w in slo_windows[-max_rows:]:
+            burns = w.get("burns") or {}
+            brief = " ".join(
+                f"{k}={v.get('burn'):.2f}" for k, v in sorted(burns.items())
+                if isinstance(v, dict) and v.get("burn") is not None)
+            fired = w.get("fired") or []
+            out.append(f"  {w.get('iter', '-'):>6} {w.get('completed', 0):>9} "
+                       f"{w.get('refused', 0):>8}  {brief}"
+                       + (f"  ALARM:{','.join(fired)}" if fired else ""))
 
     out.append("")
+    if slo_alarms:
+        out.append(f"SLO burn-rate alarms: {len(slo_alarms)}")
+        for a in slo_alarms[-5:]:
+            out.append(f"  {a.get('slo', '?')}: measured {a.get('measured')} "
+                       f"vs target {a.get('target')} "
+                       f"(burn short {a.get('burn_short'):.2f} / "
+                       f"long {a.get('burn_long'):.2f})")
     if alarms:
         out.append(f"backpressure alarms: {len(alarms)}")
         for a in alarms[-5:]:
             out.append(f"  {a.get('reason', '')}")
-    else:
+    elif not slo_alarms:
         out.append("backpressure alarms: none")
 
     counters = {}
