@@ -564,6 +564,51 @@ def run_bench() -> dict:
     except Exception as e:  # the serving row must never sink the bench
         serving_row = {"error": str(e)[:200]}
 
+    # serving fleet row (ISSUE 12): the same Poisson load against 2 engine
+    # replicas behind the load-balancing router, plus a kill-one variant
+    # (replica 0 dies mid-run via kill_at_iter) proving the fleet serves
+    # THROUGH preemption: completions still account for every arrival
+    # (drain + requeue), at a degraded-but-bounded throughput/p99 TTFT.
+    serving_fleet_row = None
+    try:
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.serving.engine import EngineConfig
+        from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
+
+        PoissonLoadGen, synthetic_request_maker = _import_loadgen()
+
+        flparams = gen_params if on_tpu else state.params
+        fl_ecfg = EngineConfig(num_slots=2, block_size=64 if on_tpu else 16)
+        fleet_sv = ServingFleet(
+            flparams, cfg,
+            fleet_cfg=FleetConfig(replicas=2, engine=fl_ecfg))
+        fl_gen = PoissonLoadGen(6, rate=2.0 if on_tpu else 5.0,
+                                streams=2, seed=0)
+        serving_fleet_row = fl_gen.run(
+            fleet_sv, synthetic_request_maker(cfg, seed=0),
+            max_wall_s=600 if on_tpu else 300,
+        )
+        serving_fleet_row["replicas"] = 2
+
+        fleet_kill = ServingFleet(
+            flparams, cfg,
+            fleet_cfg=FleetConfig(replicas=2, engine=fl_ecfg,
+                                  kill_at_iter=4))
+        kill_gen = PoissonLoadGen(6, rate=2.0 if on_tpu else 5.0,
+                                  streams=2, seed=0)
+        kill_row = kill_gen.run(
+            fleet_kill, synthetic_request_maker(cfg, seed=0),
+            max_wall_s=600 if on_tpu else 300,
+        )
+        serving_fleet_row["kill_one"] = {
+            "requests_completed": kill_row["requests_completed"],
+            "requests_refused": kill_row["requests_refused"],
+            "images_per_sec_per_chip": kill_row["images_per_sec_per_chip"],
+            "ttft_p99_s": kill_row["ttft_p99_s"],
+        }
+    except Exception as e:  # the fleet row must never sink the bench
+        serving_fleet_row = {"error": str(e)[:200]}
+
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
     # kept as a secondary row for cross-round continuity.  Each row runs as a
@@ -699,6 +744,7 @@ def run_bench() -> dict:
         "async_checkpoint": async_checkpoint_row,
         "memory": memory_row,
         "serving": serving_row,
+        "serving_fleet": serving_fleet_row,
         "sparse_attention": sparse_attention_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
@@ -764,6 +810,12 @@ GATE_SPECS = {
     "serving.latency_p99_s": ("lower", 0.5),
     "serving.queue_wait_p99_s": ("lower", 1.0),
     "serving.images_per_sec_per_chip": ("higher", 0.5),
+    "serving_fleet.ttft_p99_s": ("lower", 0.5),
+    "serving_fleet.images_per_sec_per_chip": ("higher", 0.5),
+    # the preempted variant runs degraded by design: gate it loosely, just
+    # enough to catch serve-through-preemption falling off a cliff
+    "serving_fleet.kill_one.ttft_p99_s": ("lower", 1.0),
+    "serving_fleet.kill_one.images_per_sec_per_chip": ("higher", 0.75),
     "health_overhead.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),

@@ -26,6 +26,13 @@ leaves a `kind:"request"` phase-attributed record (tools/serving_report.py
 renders the waterfall) and a stalled poll() dumps thread stacks + request
 phases via the heartbeat (`--telemetry_heartbeat_s`).
 
+Fleet mode: `--replicas N` serves through N engine replicas behind the
+load-balancing router (serving/fleet.py); `--disaggregate` moves prefill to
+a separate worker pool whose KV handoff is priced as a comms-ledger row.
+`--inject_fault kill-replica@ITER[:IDX]` kills replica IDX mid-run — its
+queued + in-flight requests drain and requeue onto the survivors (the chaos
+`kill-replica` drill asserts zero drops and one `replica_lost` alarm).
+
 Without `--dalle_path` a `--synthetic` random-init model serves (drills and
 smoke tests run without a trained checkpoint)."""
 from __future__ import annotations
@@ -74,6 +81,14 @@ def build_parser():
     eng.add_argument("--telemetry_every", type=int, default=32,
                      help="poll iterations per serving telemetry window "
                           "(serving_window events, SLO evaluation, status_json)")
+    eng.add_argument("--replicas", type=int, default=1,
+                     help="engine replicas behind the load-balancing router "
+                          "(serving/fleet.py); killing one mid-run drains + "
+                          "requeues its work onto survivors")
+    eng.add_argument("--disaggregate", action="store_true",
+                     help="run prefill on a separate worker pool and hand "
+                          "the KV prefix to the decode replicas (priced as a "
+                          "comms-ledger handoff row)")
 
     slo = parser.add_argument_group("slo")
     slo.add_argument("--slo_ttft_p99", type=float, default=None,
@@ -177,15 +192,25 @@ def main(argv=None):
     if args.no_vae:
         vae_cfg = vae_params = None
 
-    engine = GenerationEngine(
-        params, dalle_cfg, vae_params, vae_cfg,
-        engine_cfg=EngineConfig(
-            num_slots=args.slots, block_size=args.block_size,
-            num_blocks=args.num_blocks, max_queue=args.max_queue,
-            headroom_frac=args.headroom_frac, filter_thres=args.top_k,
-            telemetry_every=args.telemetry_every,
-        ),
+    engine_cfg = EngineConfig(
+        num_slots=args.slots, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_queue=args.max_queue,
+        headroom_frac=args.headroom_frac, filter_thres=args.top_k,
+        telemetry_every=args.telemetry_every,
     )
+    if args.replicas > 1 or args.disaggregate:
+        from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
+
+        engine = ServingFleet(
+            params, dalle_cfg, vae_params, vae_cfg,
+            fleet_cfg=FleetConfig(
+                replicas=args.replicas, disaggregate=args.disaggregate,
+                engine=engine_cfg,
+            ),
+        )
+    else:
+        engine = GenerationEngine(params, dalle_cfg, vae_params, vae_cfg,
+                                  engine_cfg=engine_cfg)
     slo_targets = SloTargets(
         ttft_p99_s=args.slo_ttft_p99, latency_p99_s=args.slo_latency_p99,
         images_per_sec_floor=args.slo_images_per_sec,
@@ -303,6 +328,18 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
     report["refused_total"] = obs_metrics.counter("serving/refused").value
     report["backpressure_alarms"] = obs_metrics.counter(
         "serving_backpressure_alarms").value
+    if hasattr(engine, "router"):  # fleet: preemption + disaggregation ledger
+        report["replicas"] = len(engine.engines)
+        report["replicas_alive"] = len(engine.router.alive())
+        report["replicas_lost"] = obs_metrics.counter(
+            "router/replicas_lost").value
+        report["requeued_total"] = obs_metrics.counter("router/requeued").value
+        report["router_shed"] = obs_metrics.counter("router/shed").value
+        if engine.prefill_worker is not None:
+            report["handoff_requests"] = obs_metrics.counter(
+                "serving/handoff_requests").value
+            report["handoff_bytes"] = obs_metrics.counter(
+                "serving/handoff_bytes").value
     return report
 
 

@@ -295,6 +295,36 @@ def dalle_step_comms(mesh: Union[Mapping[str, int], Any, None], params: Any,
     )
 
 
+def prefill_handoff_bytes(tcfg: Any, n_pre: int, lanes: int = 1,
+                          itemsize: int = 4) -> float:
+    """Bytes of the prefill→decode KV handoff for ONE admission: the k + v
+    prefix every layer carries, `lanes` sequences deep (a CFG-guided request
+    hands over its [cond] and [null] prefixes).  This is the dense cache
+    `write_prefill_to_pool` scatters — priced analytically so tests can
+    cross-check the figure against the actual handoff arrays' nbytes."""
+    return (2.0 * tcfg.depth * lanes * tcfg.heads * n_pre
+            * tcfg.dim_head * itemsize)
+
+
+def prefill_handoff_row(tcfg: Any, n_pre: int, lanes: int = 1,
+                        itemsize: int = 4, ring_bytes: float = 0.0,
+                        admissions_per_step: float = 1.0) -> Dict[str, Any]:
+    """The comms-ledger row for prefill/decode disaggregation: the wire
+    bytes a prefill mesh ships to a decode replica per admission (KV prefix
+    + the token-shift ring tails when shift_tokens is on).  Shaped like
+    `step_comms_ledger`'s per_axis rows so fleet reports and
+    `publish_gauges` treat it uniformly."""
+    payload = prefill_handoff_bytes(tcfg, n_pre, lanes, itemsize)
+    return {
+        "axis": "handoff", "size": 2, "op": "prefill_to_decode",
+        "bytes_per_step": (payload + ring_bytes) * admissions_per_step,
+        "payload_bytes": payload,
+        "ring_bytes": ring_bytes,
+        "n_pre": n_pre,
+        "lanes": lanes,
+    }
+
+
 def publish_gauges(ledger: Mapping[str, Any], registry=None) -> None:
     """Mirror the ledger into the metrics registry: one gauge per axis plus
     the total — the numbers the fleet report and bench rows read back."""

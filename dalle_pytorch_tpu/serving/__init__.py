@@ -3,11 +3,16 @@
 `kv_pool` owns the shared block pool (device arrays + host free list),
 `scheduler` owns the host-side request queue and admission control, and
 `engine` runs the jitted prefill/decode lifecycle that turns admitted
-prompts into images.  `cli/serve.py` is the long-lived entry point and
-`tools/loadgen.py` drives it with Poisson traffic.
+prompts into images.  `router` load-balances N replicas and requeues work
+off a lost one; `fleet` builds the replicas, optionally disaggregating
+prefill from decode behind a `PrefillWorker`.  `cli/serve.py` is the
+long-lived entry point and `tools/loadgen.py` drives it with Poisson
+traffic.
 """
 from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+from dalle_pytorch_tpu.serving.fleet import FleetConfig, PrefillWorker, ServingFleet
 from dalle_pytorch_tpu.serving.kv_pool import BlockPool
+from dalle_pytorch_tpu.serving.router import Router
 from dalle_pytorch_tpu.serving.scheduler import (
     AdmissionController,
     AdmissionRefused,
@@ -20,7 +25,11 @@ __all__ = [
     "AdmissionRefused",
     "BlockPool",
     "EngineConfig",
+    "FleetConfig",
     "GenerationEngine",
+    "PrefillWorker",
     "Request",
     "RequestQueue",
+    "Router",
+    "ServingFleet",
 ]

@@ -143,6 +143,12 @@ class GenerationEngine:
         self._iter = 0
         self._warm_decode = False
         self._flood_rng = np.random.RandomState(0)
+        # fleet hooks: a router tags this engine's request records with its
+        # replica id; a disaggregated fleet installs a prefill worker here
+        # (serving/fleet.PrefillWorker), and _do_admit ingests its handoff
+        # instead of running prefill in-engine
+        self.replica_id: Optional[int] = None
+        self.prefill_backend = None
         # observability attachments (all optional; telemetry-off poll() runs
         # the identical device schedule with only time.monotonic bookkeeping)
         self._slo = None            # observability.slo.SloMonitor
@@ -236,70 +242,88 @@ class GenerationEngine:
             codes=codes_buf,
         )
 
+    def _prefill_sample_impl(self, params, text, k0, temperature,
+                             cond_scale: float):
+        return prefill_sample(params, self.cfg, self.ecfg.filter_thres,
+                              text, k0, temperature, cond_scale)
+
+    def _ingest_impl(self, state, cache_layers, code, bt_rows, lane_idx,
+                     lanes: int):
+        """The other half of admission: scatter a prefilled KV prefix into
+        the paged pool and arm the lanes.  Pure data movement on the handoff
+        payload — shared verbatim by the fused admit jit and the
+        disaggregated ingest jit, which is what makes the two paths
+        bit-identical."""
+        tcfg = self.tcfg
+        pool = write_prefill_to_pool(
+            tcfg, state["pool"], bt_rows, cache_layers,
+            self.n_pre, self.ecfg.block_size,
+        )
+        rings = state["rings"]
+        if rings is not None:
+            if tcfg.scan_layers:
+                rl, cl = rings["layers"], cache_layers
+                rings = {"layers": dict(
+                    rl,
+                    shift_attn=rl["shift_attn"].at[:, lane_idx].set(
+                        cl["shift_attn"].astype(rl["shift_attn"].dtype)),
+                    shift_ff=rl["shift_ff"].at[:, lane_idx].set(
+                        cl["shift_ff"].astype(rl["shift_ff"].dtype)),
+                )}
+            else:
+                new_layers = []
+                for rl, cl in zip(rings["layers"], cache_layers):
+                    new_layers.append({
+                        "shift_attn": rl["shift_attn"].at[lane_idx].set(
+                            cl["shift_attn"].astype(rl["shift_attn"].dtype)),
+                        "shift_ff": rl["shift_ff"].at[lane_idx].set(
+                            cl["shift_ff"].astype(rl["shift_ff"].dtype)),
+                    })
+                rings = {"layers": new_layers}
+
+        codeb = jnp.broadcast_to(code, (lanes,))
+        return dict(
+            state,
+            pool=pool,
+            rings=rings,
+            block_tables=state["block_tables"].at[lane_idx].set(bt_rows),
+            codes=state["codes"].at[lane_idx, 0].set(codeb),
+            prev_code=state["prev_code"].at[lane_idx].set(codeb),
+            offsets=state["offsets"].at[lane_idx].set(self.n_pre),
+            img_prev=state["img_prev"].at[lane_idx].set(0),
+        )
+
     def _admit_fn_for(self, cond_scale: float, lanes: int):
         key = (float(cond_scale), lanes)  # host-sync-ok: python jit-cache key
         fn = self._admit_fns.get(key)
         if fn is not None:
             return fn
-        cfg, tcfg = self.cfg, self.tcfg
-        guided = cond_scale != 1.0
 
         def admit(params, state, text, k0, temperature, bt_rows, lane_idx):
-            cache, last_logits = sampling_mod._prefill_phase(
-                params, cfg, text, None, 0, cond_scale
-            )
-            lg = (sampling_mod._cfg_combine(last_logits, cond_scale)
-                  if guided else last_logits)
-            filtered = top_k_filter(lg, thres=self.ecfg.filter_thres)
-            # cast to the logits dtype: the fused path's python-float
-            # temperature is WEAKLY typed (bf16 logits stay bf16 through the
-            # division); a strong f32 scalar would promote and break parity
-            tok = gumbel_sample(k0, filtered,
-                                temperature=temperature.astype(filtered.dtype))
-            code = jnp.clip(
-                tok - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1
-            ).astype(jnp.int32)  # (1,)
-
-            pool = write_prefill_to_pool(
-                tcfg, state["pool"], bt_rows, cache["layers"],
-                self.n_pre, self.ecfg.block_size,
-            )
-            rings = state["rings"]
-            if rings is not None:
-                if tcfg.scan_layers:
-                    rl, cl = rings["layers"], cache["layers"]
-                    rings = {"layers": dict(
-                        rl,
-                        shift_attn=rl["shift_attn"].at[:, lane_idx].set(
-                            cl["shift_attn"].astype(rl["shift_attn"].dtype)),
-                        shift_ff=rl["shift_ff"].at[:, lane_idx].set(
-                            cl["shift_ff"].astype(rl["shift_ff"].dtype)),
-                    )}
-                else:
-                    new_layers = []
-                    for rl, cl in zip(rings["layers"], cache["layers"]):
-                        new_layers.append({
-                            "shift_attn": rl["shift_attn"].at[lane_idx].set(
-                                cl["shift_attn"].astype(rl["shift_attn"].dtype)),
-                            "shift_ff": rl["shift_ff"].at[lane_idx].set(
-                                cl["shift_ff"].astype(rl["shift_ff"].dtype)),
-                        })
-                    rings = {"layers": new_layers}
-
-            codeb = jnp.broadcast_to(code, (lanes,))
-            return dict(
-                state,
-                pool=pool,
-                rings=rings,
-                block_tables=state["block_tables"].at[lane_idx].set(bt_rows),
-                codes=state["codes"].at[lane_idx, 0].set(codeb),
-                prev_code=state["prev_code"].at[lane_idx].set(codeb),
-                offsets=state["offsets"].at[lane_idx].set(self.n_pre),
-                img_prev=state["img_prev"].at[lane_idx].set(0),
-            )
+            cache_layers, code = self._prefill_sample_impl(
+                params, text, k0, temperature, cond_scale)
+            return self._ingest_impl(
+                state, cache_layers, code, bt_rows, lane_idx, lanes)
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
         fn = jax.jit(admit, donate_argnums=donate)
+        self._admit_fns[key] = fn
+        return fn
+
+    def _ingest_fn_for(self, lanes: int):
+        """Jitted pool-write for a handoff produced elsewhere (the decode
+        side of prefill/decode disaggregation)."""
+        key = ("ingest", lanes)
+        fn = self._admit_fns.get(key)
+        if fn is not None:
+            return fn
+
+        def ingest(state, cache_layers, code, bt_rows, lane_idx):
+            return self._ingest_impl(
+                state, cache_layers, code, bt_rows, lane_idx, lanes)
+
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(ingest, donate_argnums=donate)
         self._admit_fns[key] = fn
         return fn
 
@@ -331,7 +355,7 @@ class GenerationEngine:
             self.queue.push(req)
         except AdmissionRefused as e:
             obs_metrics.counter("serving/refused").inc()
-            self.admission.note_refusal(e.reason)
+            self.admission.note_refusal(e.reason, kind=e.kind)
             req.phases["queue_wait"] = time.monotonic() - req.arrival_t
             self._finish_record(req, "shed", reason=e.reason)
             raise
@@ -339,14 +363,15 @@ class GenerationEngine:
         return req
 
     def submit_when_able(self, text, key=None, temperature: float = 1.0,
-                         cond_scale: float = 1.0) -> Request:
+                         cond_scale: float = 1.0,
+                         synthetic: bool = False) -> Request:
         """Blocking submit for batch callers (generate.py --engine, the
-        prompt-mode serve CLI): a full queue BLOCKS — the engine polls until
-        a slot frees — instead of refusing.  Counted as ONE
-        `serving/submit_waits`, not a refusal per retry (those counters
-        measure shed load, which a waiting batch caller is not).  A request
-        that can NEVER fit the pool still refuses outright."""
-        req = self._make_request(text, key, temperature, cond_scale, False)
+        prompt-mode serve CLI) and router requeues: a full queue BLOCKS —
+        the engine polls until a slot frees — instead of refusing.  Counted
+        as ONE `serving/submit_waits`, not a refusal per retry (those
+        counters measure shed load, which a waiting batch caller is not).  A
+        request that can NEVER fit the pool still refuses outright."""
+        req = self._make_request(text, key, temperature, cond_scale, synthetic)
         try:
             self.admission.screen_submit(req)
         except AdmissionRefused as e:
@@ -368,6 +393,78 @@ class GenerationEngine:
     def busy(self) -> bool:
         """Work pending: queued or in-flight requests."""
         return bool(len(self.queue) or self._inflight)
+
+    @property
+    def free_slots(self) -> int:
+        """Decode lanes currently free (a router placement input)."""
+        return len(self._free_lanes)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """Stop serving and EXPORT every unfinished request so a survivor
+        can re-serve it exactly: for each queued and in-flight request,
+        return the prompt, the ORIGINAL request key, sampling knobs, and the
+        RNG stream position (`codes_done`) plus the codes accepted so far.
+
+        Per-request RNG streams make the re-decode exact — a fresh engine
+        given the same (text, key, temperature, cond_scale) derives the
+        identical key stream, so its output is bit-identical and the
+        exported `codes` prefix must match the resubmission's first
+        `codes_done` codes (tests/test_fleet_serving.py proves it).
+
+        Each drained request still leaves its single terminal record on THIS
+        engine — outcome "deferred" with `requeued: true` — and its lanes
+        and pool blocks are freed, leaving the engine empty but usable."""
+        now = time.monotonic()
+        exports: List[Dict[str, Any]] = []
+
+        def _export(req: Request, codes: Optional[np.ndarray]) -> Dict[str, Any]:
+            return {
+                "text": np.asarray(req.text, np.int32),  # host-sync-ok: drain exports live on host
+                "key": np.asarray(req.key, np.uint32),  # host-sync-ok: drain exports live on host
+                "temperature": req.temperature,
+                "cond_scale": req.cond_scale,
+                "synthetic": req.synthetic,
+                "codes_done": req.codes_done,  # RNG stream position
+                "codes": codes,                # accepted prefix (None if queued)
+                "origin_id": req.id,
+                "origin_replica": self.replica_id,
+            }
+
+        while True:
+            req = self.queue.peek()
+            if req is None:
+                break
+            self.queue.pop()
+            req.phases["queue_wait"] = now - req.arrival_t
+            exports.append(_export(req, None))
+            self._finish_record(req, "deferred", requeued=True)
+        all_lanes: List[int] = []
+        for req in self._inflight:
+            if req.admitted_t is not None:
+                req.phases["decode"] = now - req.admitted_t
+            codes = np.asarray(  # host-sync-ok: exporting the drained slot's accepted codes
+                self._state["codes"][req.lanes[0], :req.codes_done]
+            )
+            exports.append(_export(req, codes))
+            self._finish_record(req, "deferred", requeued=True)
+            for i in range(len(req.lanes)):
+                self.pool.free_table((req.id << 1) | i)
+            all_lanes.extend(req.lanes)
+            self._free_lanes.extend(req.lanes)
+        self._inflight = []
+        if all_lanes:
+            li = jnp.asarray(all_lanes, jnp.int32)
+            st = self._state
+            self._state = dict(
+                st,
+                active=st["active"].at[li].set(False),
+                block_tables=st["block_tables"].at[li].set(0),
+                offsets=st["offsets"].at[li].set(0),
+                img_prev=st["img_prev"].at[li].set(0),
+            )
+        obs_metrics.counter("serving/drained").inc(len(exports))
+        self._window_event()
+        return exports
 
     def poll(self) -> List[Request]:
         """One engine iteration: flood-fault poll, admissions, one fused
@@ -489,6 +586,8 @@ class GenerationEngine:
         tele = telemetry.active()
         if tele is None:
             return
+        if self.replica_id is not None:
+            extra.setdefault("replica", self.replica_id)
         tele.spans.write_event(
             "request", request_id=req.id, outcome=outcome,
             guided=req.guided, synthetic=req.synthetic,
@@ -561,16 +660,30 @@ class GenerationEngine:
         step_keys = jax.random.split(key, max(self.n_gen - 1, 1))
 
         text = jnp.asarray(req.text[None], jnp.int32)
-        admit_fn = self._admit_fn_for(req.cond_scale, len(lanes))
         lane_idx = jnp.asarray(lanes, jnp.int32)
         t_dispatch = time.monotonic()
         req.phases["admission"] = t_dispatch - t_pop
-        with self._suspend_compiles():
-            self._state = admit_fn(
-                self.params, self._state, text, k0,
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(tables, jnp.int32), lane_idx,
-            )
+        if self.prefill_backend is not None:
+            # disaggregated: the prefill worker ran _prefill_sample_impl on
+            # ITS mesh (deriving the same k0 from req.key) and hands the KV
+            # prefix + first code over; this side only scatters it into the
+            # pool — the ingest jit is the identical graph the fused admit
+            # traces, so the two paths stay bit-identical
+            handoff = self.prefill_backend.prefill(req)
+            ingest_fn = self._ingest_fn_for(len(lanes))
+            with self._suspend_compiles():
+                self._state = ingest_fn(
+                    self._state, handoff["layers"], handoff["code"],
+                    jnp.asarray(tables, jnp.int32), lane_idx,
+                )
+        else:
+            admit_fn = self._admit_fn_for(req.cond_scale, len(lanes))
+            with self._suspend_compiles():
+                self._state = admit_fn(
+                    self.params, self._state, text, k0,
+                    jnp.asarray(req.temperature, jnp.float32),
+                    jnp.asarray(tables, jnp.int32), lane_idx,
+                )
         # host-owned lane metadata (small per-admission device updates)
         st = self._state
         cond = lanes[0]
@@ -750,3 +863,28 @@ def _blocks_per_seq(tcfg, block_size: int) -> int:
     from dalle_pytorch_tpu.models.transformer import paged_blocks_per_seq
 
     return paged_blocks_per_seq(tcfg, block_size)
+
+
+def prefill_sample(params, cfg, filter_thres: float, text, k0, temperature,
+                   cond_scale: float):
+    """Prefill + first-token sample — the half of admission that only needs
+    params and the prompt.  Module-level so a disaggregated prefill worker
+    (serving/fleet.PrefillWorker) traces the IDENTICAL graph on its own
+    mesh; the returned (cache_layers, code) is the prefill→decode handoff
+    payload the decode replica's ingest jit scatters into its pool."""
+    guided = cond_scale != 1.0
+    cache, last_logits = sampling_mod._prefill_phase(
+        params, cfg, text, None, 0, cond_scale
+    )
+    lg = (sampling_mod._cfg_combine(last_logits, cond_scale)
+          if guided else last_logits)
+    filtered = top_k_filter(lg, thres=filter_thres)
+    # cast to the logits dtype: the fused path's python-float temperature is
+    # WEAKLY typed (bf16 logits stay bf16 through the division); a strong
+    # f32 scalar would promote and break parity
+    tok = gumbel_sample(k0, filtered,
+                        temperature=temperature.astype(filtered.dtype))
+    code = jnp.clip(
+        tok - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1
+    ).astype(jnp.int32)  # (1,)
+    return cache["layers"], code

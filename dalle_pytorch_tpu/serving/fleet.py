@@ -1,0 +1,294 @@
+"""Serving fleet: N engine replicas behind a router, with optional
+prefill/decode disaggregation and serve-through-preemption.
+
+The PR 7 engine is one process on one mesh; this module multiplies it:
+
+* **Replicas** — `ServingFleet` builds N `GenerationEngine`s (each tagging
+  its request records with its replica id) and fronts them with
+  `serving/router.Router`, which places admissions on live load (queue
+  depth, free slots, free pool blocks, HBM headroom) and turns
+  every-replica-refused into a counted router-level shed.  The fleet
+  quacks like one engine (submit/poll/busy/run_until_idle), so
+  tools/loadgen.py, cli/serve.py, and bench.py drive it unchanged.
+* **Disaggregation** — `PrefillWorker` runs the prefill half of admission
+  (`engine.prefill_sample`, the identical traced graph) on its OWN params —
+  optionally placed on a different mesh through the PR 6 registry
+  (`parallel/reshard.reshard_tree`) — and hands the KV prefix + first code
+  to the decode replica, whose ingest jit scatters it into the paged pool
+  via `write_prefill_to_pool`.  The handoff is priced as a comms-ledger row
+  (`observability.comms.prefill_handoff_row`) and counted in
+  `serving/handoff_bytes`; decode output is bit-identical to the fused
+  single-engine path (tests/test_fleet_serving.py proves it).
+* **Preemption** — `kill_replica(i)` (or an armed `kill-replica@ITER:IDX`
+  fault, polled like the engine polls flood faults) drains the dead
+  replica's per-slot state and the router requeues it onto survivors;
+  per-request RNG streams make the re-decode exact.  With
+  `reshard_on_kill`, survivors re-place their weights through
+  `parallel/reshard.py` — the serving counterpart of elastic training
+  resume.
+
+Host work here is deliberate and identical in kind to the engine's own
+(admission bookkeeping, handoff dispatch); the steady-state decode loops
+stay async inside each replica.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.observability import comms as comms_mod
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.serving.engine import (
+    EngineConfig,
+    GenerationEngine,
+    prefill_sample,
+)
+from dalle_pytorch_tpu.serving.router import Router
+from dalle_pytorch_tpu.serving.scheduler import Request
+from dalle_pytorch_tpu.training import resilience
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet knobs on top of one shared per-replica EngineConfig.
+
+    `kill_at_iter`/`kill_replica_idx` are the in-process chaos hook bench
+    and tests use directly; live runs arm the same drill with
+    `--inject_fault kill-replica@ITER:IDX` instead."""
+
+    replicas: int = 2
+    disaggregate: bool = False
+    engine: EngineConfig = EngineConfig()
+    reshard_on_kill: bool = False
+    kill_at_iter: Optional[int] = None
+    kill_replica_idx: int = 0
+
+
+class PrefillWorker:
+    """The prefill half of admission as its own pool: runs
+    `engine.prefill_sample` — the exact graph the fused admit traces — on
+    its own params (optionally on its own mesh via `parallel/reshard.py`'s
+    registry placement) and returns the handoff a decode replica ingests.
+
+    One worker serves every replica: prefill is stateless (params + prompt
+    in, KV prefix + first code out), so the pool "size" is just how many
+    workers a deployment constructs."""
+
+    def __init__(self, params: dict, cfg, filter_thres: float = 0.9,
+                 mesh=None):
+        if mesh is not None:
+            from dalle_pytorch_tpu.parallel.reshard import reshard_tree
+
+            params = reshard_tree(params, mesh)
+        self.params = params
+        self.cfg = cfg
+        self.tcfg = cfg.transformer_config()
+        self.filter_thres = filter_thres
+        self.n_pre = cfg.text_seq_len + 1
+        self.itemsize = np.dtype(
+            params["logits_linear"]["w"].dtype).itemsize
+        self._fns: Dict[float, Any] = {}
+
+    def _fn_for(self, cond_scale: float):
+        key = float(cond_scale)  # host-sync-ok: python jit-cache key
+        fn = self._fns.get(key)
+        if fn is None:
+            cfg, thres = self.cfg, self.filter_thres
+
+            def run(params, text, k0, temperature):
+                return prefill_sample(params, cfg, thres, text, k0,
+                                      temperature, cond_scale)
+
+            fn = jax.jit(run)
+            self._fns[key] = fn
+        return fn
+
+    def handoff_row(self, lanes: int = 1) -> Dict[str, Any]:
+        """The comms-ledger row pricing one admission's handoff."""
+        ring = 0.0
+        if self.tcfg.shift_tokens:
+            # both token-shift ring tails (attn + ff), per layer:
+            # (lanes, fmap, 2, dim//4) each — see transformer.init_cache
+            ring = (2.0 * self.tcfg.depth * lanes * self.tcfg.image_fmap_size
+                    * 2 * (self.tcfg.dim // 4) * self.itemsize)
+        return comms_mod.prefill_handoff_row(
+            self.tcfg, self.n_pre, lanes, self.itemsize, ring_bytes=ring)
+
+    def prefill(self, req: Request) -> Dict[str, Any]:
+        """Run prefill + first-token sample for `req` and return the handoff
+        package.  The RNG derivation mirrors the engine's `_do_admit` (and
+        so `sample_image_codes`) exactly: k0 is the first split of the
+        request key, which is what keeps disaggregated output bit-identical."""
+        _, k0 = jax.random.split(jnp.asarray(req.key, jnp.uint32))
+        fn = self._fn_for(req.cond_scale)
+        layers, code = fn(
+            self.params, jnp.asarray(req.text[None], jnp.int32), k0,
+            jnp.asarray(req.temperature, jnp.float32),
+        )
+        lanes = 2 if req.cond_scale != 1.0 else 1
+        row = self.handoff_row(lanes)
+        obs_metrics.counter("serving/handoff_requests").inc()
+        obs_metrics.counter("serving/handoff_bytes").inc(
+            row["bytes_per_step"])
+        return {"layers": layers, "code": code, "lanes": lanes,
+                "comms_row": row}
+
+
+class ServingFleet:
+    """N replicas + router with the single-engine serving surface."""
+
+    def __init__(self, params: dict, cfg, vae_params: Optional[dict] = None,
+                 vae_cfg: Any = None, fleet_cfg: FleetConfig = FleetConfig(),
+                 usage_fn=None, on_alarm=None):
+        assert fleet_cfg.replicas >= 1
+        self.cfg = cfg
+        self.fcfg = fleet_cfg
+        self.engines: List[GenerationEngine] = [
+            GenerationEngine(params, cfg, vae_params, vae_cfg,
+                             engine_cfg=fleet_cfg.engine, usage_fn=usage_fn)
+            for _ in range(fleet_cfg.replicas)
+        ]
+        self.router = Router(self.engines, on_alarm=on_alarm)
+        self.prefill_worker: Optional[PrefillWorker] = None
+        if fleet_cfg.disaggregate:
+            self.prefill_worker = PrefillWorker(
+                params, cfg, filter_thres=fleet_cfg.engine.filter_thres)
+            for eng in self.engines:
+                eng.prefill_backend = self.prefill_worker
+        self._iter = 0
+        self._killed: List[int] = []
+
+    # ------------------------------------------------------ engine surface
+    def submit(self, text, key=None, temperature: float = 1.0,
+               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+        return self.router.submit(text, key=key, temperature=temperature,
+                                  cond_scale=cond_scale, synthetic=synthetic)
+
+    def submit_when_able(self, text, key=None, temperature: float = 1.0,
+                         cond_scale: float = 1.0) -> Request:
+        return self.router.submit_when_able(
+            text, key=key, temperature=temperature, cond_scale=cond_scale)
+
+    @property
+    def busy(self) -> bool:
+        return self.router.busy
+
+    def poll(self) -> List[Request]:
+        """One fleet iteration: arm/fire the kill-replica drill, poll every
+        live replica, refresh the fleet gauges."""
+        self._iter += 1
+        idx = resilience.take_kill_replica_fault(self._iter)
+        if (idx is None and self.fcfg.kill_at_iter is not None
+                and self._iter >= self.fcfg.kill_at_iter
+                and not self._killed):
+            idx = self.fcfg.kill_replica_idx
+        if idx is not None:
+            self.kill_replica(int(idx))  # host-sync-ok: parsed CLI number
+        done = self.router.poll()
+        self.router.publish_gauges()
+        return done
+
+    def run_until_idle(self, max_iters: Optional[int] = None) -> List[Request]:
+        out: List[Request] = []
+        iters = 0
+        while self.busy:
+            out.extend(self.poll())
+            iters += 1
+            if max_iters is not None and iters >= max_iters:
+                break
+        return out
+
+    def generate(self, texts, keys=None, temperature: float = 1.0,
+                 cond_scale: float = 1.0) -> List[Request]:
+        texts = np.asarray(texts)  # host-sync-ok: caller-provided host prompts
+        reqs = []
+        for i in range(texts.shape[0]):
+            k = keys[i] if keys is not None else jax.random.PRNGKey(i)
+            reqs.append(self.submit_when_able(
+                texts[i], key=k, temperature=temperature,
+                cond_scale=cond_scale))
+            # blocking submits only poll the CHOSEN replica; keep the whole
+            # fleet advancing between submissions
+            self.poll()
+        self.run_until_idle()
+        return reqs
+
+    def close(self) -> None:
+        for r in self.router.alive():
+            r.engine.close()
+
+    # ---------------------------------------------------------- preemption
+    def kill_replica(self, idx: int, reason: str = "killed") -> List[Request]:
+        """Simulated replica death: drain + requeue through the router;
+        optionally reshard the survivors' weights (the elastic-serving
+        counterpart of PR 6's shrink resume)."""
+        if len(self.router.alive()) <= 1:
+            print(f"[fleet] refusing to kill replica {idx}: it is the last "
+                  "one alive", flush=True)
+            return []
+        print(f"[chaos] kill-replica: draining replica {idx} at fleet "
+              f"iteration {self._iter}", flush=True)
+        requeued = self.router.mark_lost(idx, reason=reason)
+        self._killed.append(idx)
+        if self.fcfg.reshard_on_kill:
+            self._reshard_survivors()
+        return requeued
+
+    def _reshard_survivors(self) -> None:
+        """Re-place every survivor's params onto its own (surviving) mesh
+        through the partitioning registry — on one device this replicates
+        in place; on a real submesh the same call moves the shards."""
+        from jax.sharding import Mesh
+
+        from dalle_pytorch_tpu.parallel.reshard import reshard_tree
+
+        t0 = time.monotonic()
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))  # host-sync-ok: device handles, not array data
+        for r in self.router.alive():
+            r.engine.params = reshard_tree(r.engine.params, mesh)
+        if self.prefill_worker is not None:
+            self.prefill_worker.params = reshard_tree(
+                self.prefill_worker.params, mesh)
+        obs_metrics.gauge("fleet_serving/reshard_s").set(
+            time.monotonic() - t0)
+
+    # ------------------------------------------------------- observability
+    @property
+    def pool(self):
+        """Replica 0's pool — the CLI report surface; per-replica pools stay
+        reachable through `engines[i].pool`."""
+        return self.engines[0].pool
+
+    def attach_slo(self, monitor, status_path: Optional[str] = None) -> None:
+        self.engines[0].attach_slo(monitor, status_path=status_path)
+
+    def attach_capture(self, trigger) -> None:
+        self.engines[0].attach_capture(trigger)
+
+    def phase_state(self) -> Dict[str, Any]:
+        return {
+            "iter": self._iter,
+            "replicas_alive": [r.id for r in self.router.alive()],
+            "replicas": {r.id: r.engine.phase_state()
+                         for r in self.router.alive()},
+        }
+
+    def memory_ledger(self, capacity_bytes: Optional[float] = None):
+        return self.engines[0].memory_ledger(capacity_bytes=capacity_bytes)
+
+    def handoff_ledger(self) -> Optional[Dict[str, Any]]:
+        """The disaggregation comms ledger (None when not disaggregated):
+        one `prefill_to_decode` row, same shape as step_comms_ledger rows."""
+        if self.prefill_worker is None:
+            return None
+        row = self.prefill_worker.handoff_row(lanes=1)
+        return {
+            "mesh": {"prefill": 1, "decode": len(self.router.alive())},
+            "per_axis": [row],
+            "total_bytes_per_step": row["bytes_per_step"],
+        }

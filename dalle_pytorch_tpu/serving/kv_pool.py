@@ -28,6 +28,7 @@ from dalle_pytorch_tpu.models.transformer import (
     init_paged_pool,
     paged_blocks_per_seq,
 )
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
 
 
 class PoolExhausted(RuntimeError):
@@ -55,6 +56,7 @@ class BlockPool:
         # physical ids 1..num_blocks; 0 is the trash block
         self._free: List[int] = list(range(1, self.num_blocks + 1))
         self._owned: Dict[int, List[int]] = {}
+        self._high_water = 0
 
     # -- device side --------------------------------------------------------
     def device_pool(self, dtype=None) -> dict:
@@ -85,6 +87,37 @@ class BlockPool:
     def occupancy_frac(self) -> float:
         return self.used_blocks / self.num_blocks
 
+    @property
+    def high_water(self) -> int:
+        """Most blocks ever in use at once — the capacity-planning number a
+        router and the flood drill size pools from ("how big did it get",
+        not "how big is it now")."""
+        return self._high_water
+
+    @property
+    def fragmentation_frac(self) -> float:
+        """1 - (largest contiguous free run / free blocks).  Allocation is
+        whole-sequence so fragmentation never blocks an admission here, but
+        a quantized/compacted pool gathers faster from contiguous blocks —
+        the gauge tracks how scattered the free list has become."""
+        if not self._free:
+            return 0.0
+        ids = sorted(self._free)
+        best = run = 1
+        for a, b in zip(ids, ids[1:]):
+            run = run + 1 if b == a + 1 else 1
+            best = max(best, run)
+        return 1.0 - best / len(self._free)
+
+    def publish_gauges(self) -> None:
+        """Mirror the free-list state into the metrics registry — the
+        router's placement scores and the chaos drills read these instead of
+        reaching into engine internals."""
+        obs_metrics.gauge("serving/pool_blocks_free").set(self.free_blocks)
+        obs_metrics.gauge("serving/pool_high_water").set(self._high_water)
+        obs_metrics.gauge("serving/pool_fragmentation_frac").set(
+            self.fragmentation_frac)
+
     def can_admit(self) -> bool:
         return len(self._free) >= self.blocks_per_seq
 
@@ -103,6 +136,8 @@ class BlockPool:
             )
         blocks = [self._free.pop() for _ in range(self.blocks_per_seq)]
         self._owned[owner] = blocks
+        self._high_water = max(self._high_water, self.used_blocks)
+        self.publish_gauges()
         return np.asarray(blocks, np.int32)  # host-sync-ok: host free-list ids
 
     def free_table(self, owner: int) -> None:
@@ -110,6 +145,7 @@ class BlockPool:
         blocks = self._owned.pop(owner, None)
         if blocks:
             self._free.extend(blocks)
+            self.publish_gauges()
 
     def owners(self) -> List[int]:
         return list(self._owned)

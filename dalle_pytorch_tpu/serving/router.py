@@ -1,0 +1,197 @@
+"""Multi-replica request router: load-balanced admission + requeue-on-loss.
+
+Pure host logic over N engine replicas (serving/fleet.py builds them; any
+object with the GenerationEngine surface works).  Placement reads each
+replica's LIVE load — queue depth, free decode slots, free pool blocks, and
+the HBM usage fraction the admission controller already samples — and
+scores replicas so a new request lands where it will start decoding
+soonest.  A replica's own admission control stays the authority: the router
+only picks the order to try, and when EVERY live replica refuses, that
+becomes a router-level shed (`router/shed` counter, the per-kind refusal
+counters fire on the replicas).
+
+Serve-through-preemption: `mark_lost(i)` drains the dead replica
+(engine.drain() exports per-slot state: prompt, accepted codes, RNG stream
+position), emits ONE `replica_lost` alarm through the telemetry hub, and
+requeues every export onto the survivors with BLOCKING submits — a request
+the fleet accepted is never silently dropped; per-request RNG streams make
+the survivor's re-decode bit-identical.
+
+Everything here is time.monotonic/free-list bookkeeping on host values the
+engines already hold — no device syncs (tools/lint_host_sync.py covers this
+file via the serving/ directory target).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused, Request
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine behind the router."""
+
+    id: int
+    engine: Any
+    alive: bool = True
+
+
+class Router:
+    """Fronts N engine replicas; balances on live load, sheds when all
+    refuse, requeues a lost replica's work onto survivors."""
+
+    def __init__(self, engines: List[Any], on_alarm=None):
+        assert engines, "a router needs at least one replica"
+        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        for r in self.replicas:
+            r.engine.replica_id = r.id
+        self.on_alarm = on_alarm
+        obs_metrics.gauge("fleet_serving/replicas_alive").set(
+            len(self.replicas))
+
+    # ----------------------------------------------------------- placement
+    def alive(self) -> List[Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def replica_load(self, r: Replica) -> Dict[str, Any]:
+        """The placement inputs, all host-held: queue depth (fraction of the
+        cap), busy decode slots, pool occupancy, and the live HBM usage
+        fraction (None on backends without allocator stats)."""
+        eng = r.engine
+        usage = None
+        try:
+            usage = eng.admission.usage_fn()
+        except Exception:  # allocator stats must never break placement
+            usage = None
+        slots = eng.ecfg.num_slots
+        return {
+            "replica": r.id,
+            "queue_depth": len(eng.queue),
+            "queue_frac": len(eng.queue) / max(eng.queue.max_depth, 1),
+            "free_slots": eng.free_slots,
+            "slots_busy_frac": (slots - eng.free_slots) / max(slots, 1),
+            "pool_used_frac": eng.pool.occupancy_frac,
+            "pool_free_blocks": eng.pool.free_blocks,
+            "hbm_usage": usage,
+        }
+
+    @staticmethod
+    def score(load: Dict[str, Any]) -> float:
+        """Lower = admit sooner.  Queue depth dominates (it is pure waiting),
+        then busy slots and pool pressure; HBM headroom breaks ties so a
+        replica flirting with its deferral threshold is tried last."""
+        return (
+            2.0 * load["queue_frac"]
+            + 1.0 * load["slots_busy_frac"]
+            + 1.0 * load["pool_used_frac"]
+            + 0.5 * (load["hbm_usage"] or 0.0)
+        )
+
+    def ranked(self) -> List[Replica]:
+        live = self.alive()
+        return sorted(live, key=lambda r: self.score(self.replica_load(r)))
+
+    # ----------------------------------------------------------- admission
+    def submit(self, text, key=None, temperature: float = 1.0,
+               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+        """Place one request on the best-scored live replica; fall through
+        the ranking on refusal.  All replicas refusing is a ROUTER-level
+        shed (counted), re-raised so callers see one AdmissionRefused."""
+        last: Optional[AdmissionRefused] = None
+        for r in self.ranked():
+            try:
+                req = r.engine.submit(
+                    text, key=key, temperature=temperature,
+                    cond_scale=cond_scale, synthetic=synthetic)
+                obs_metrics.counter(f"router/submitted_r{r.id}").inc()
+                return req
+            except AdmissionRefused as e:
+                last = e
+        obs_metrics.counter("router/shed").inc()
+        if last is not None:
+            raise last
+        raise AdmissionRefused("no live replicas", kind="fleet_saturated")
+
+    def submit_when_able(self, text, key=None, temperature: float = 1.0,
+                         cond_scale: float = 1.0,
+                         synthetic: bool = False) -> Request:
+        """Blocking placement (batch callers, requeues): the best-scored
+        replica that could EVER serve the request waits for room instead of
+        refusing."""
+        last: Optional[AdmissionRefused] = None
+        for r in self.ranked():
+            try:
+                req = r.engine.submit_when_able(
+                    text, key=key, temperature=temperature,
+                    cond_scale=cond_scale, synthetic=synthetic)
+                obs_metrics.counter(f"router/submitted_r{r.id}").inc()
+                return req
+            except AdmissionRefused as e:
+                last = e
+        obs_metrics.counter("router/shed").inc()
+        if last is not None:
+            raise last
+        raise AdmissionRefused("no live replicas", kind="fleet_saturated")
+
+    # ------------------------------------------------------------- serving
+    @property
+    def busy(self) -> bool:
+        return any(r.engine.busy for r in self.alive())
+
+    def poll(self) -> List[Request]:
+        done: List[Request] = []
+        for r in self.alive():
+            done.extend(r.engine.poll())
+        return done
+
+    def publish_gauges(self) -> None:
+        for r in self.alive():
+            load = self.replica_load(r)
+            obs_metrics.gauge(f"fleet_serving/r{r.id}_queue_depth").set(
+                load["queue_depth"])
+            obs_metrics.gauge(f"fleet_serving/r{r.id}_free_slots").set(
+                load["free_slots"])
+            obs_metrics.gauge(f"fleet_serving/r{r.id}_pool_free_blocks").set(
+                load["pool_free_blocks"])
+
+    # ---------------------------------------------------------- preemption
+    def mark_lost(self, idx: int, reason: str = "killed") -> List[Request]:
+        """A replica died: drain its queued + in-flight requests, alarm
+        `replica_lost` ONCE through the hub, and requeue every export onto
+        the survivors (blocking — an accepted request is never dropped).
+        Returns the requeued Request objects on their new replicas."""
+        r = self.replicas[idx]
+        if not r.alive:
+            return []
+        r.alive = False
+        exports = r.engine.drain()
+        survivors = self.alive()
+        obs_metrics.counter("router/replicas_lost").inc()
+        obs_metrics.gauge("fleet_serving/replicas_alive").set(len(survivors))
+        self._alarm({
+            "type": "replica_lost", "replica": idx, "reason": reason,
+            "requeued": len(exports), "survivors": len(survivors),
+        })
+        requeued: List[Request] = []
+        for exp in exports:
+            requeued.append(self.submit_when_able(
+                exp["text"], key=exp["key"],
+                temperature=exp["temperature"],
+                cond_scale=exp["cond_scale"],
+                synthetic=exp["synthetic"],
+            ))
+            obs_metrics.counter("router/requeued").inc()
+        return requeued
+
+    def _alarm(self, fields: Dict[str, Any]) -> None:
+        if self.on_alarm is not None:
+            self.on_alarm(dict(fields))
+            return
+        tele = telemetry.active()
+        if tele is not None:
+            f = dict(fields)
+            tele.alarm(f.pop("type", "replica_lost"), **f)

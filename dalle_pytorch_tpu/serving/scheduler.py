@@ -34,11 +34,18 @@ from dalle_pytorch_tpu.observability import metrics as obs_metrics
 
 
 class AdmissionRefused(RuntimeError):
-    """The service refused a request outright (queue full / can never fit)."""
+    """The service refused a request outright (queue full / can never fit).
 
-    def __init__(self, reason: str):
+    `kind` is the machine-readable refusal class (`queue_overflow`,
+    `never_fits`, `fleet_saturated`) — `AdmissionController.note_refusal`
+    counts a `serving/refused_<kind>` counter per class, so dashboards and
+    the chaos drills can distinguish "the queue was full" from "this request
+    can never be served" without parsing the human-readable reason."""
+
+    def __init__(self, reason: str, kind: str = "other"):
         super().__init__(reason)
         self.reason = reason
+        self.kind = kind
 
 
 @dataclasses.dataclass
@@ -98,7 +105,8 @@ class RequestQueue:
     def push(self, req: Request) -> None:
         if len(self._q) >= self.max_depth:
             raise AdmissionRefused(
-                f"queue full ({self.max_depth} requests waiting)"
+                f"queue full ({self.max_depth} requests waiting)",
+                kind="queue_overflow",
             )
         self._q.append(req)
         obs_metrics.gauge("serving/queue_depth").set(len(self._q))
@@ -143,7 +151,8 @@ class AdmissionController:
             raise AdmissionRefused(
                 f"request needs {req.lanes_needed} x {self.pool.blocks_per_seq} "
                 f"blocks but the pool only has {self.pool.num_blocks} — "
-                "grow --num_blocks or shrink --block_size"
+                "grow --num_blocks or shrink --block_size",
+                kind="never_fits",
             )
 
     def may_admit(self, req: Request, free_lanes: int,
@@ -185,10 +194,12 @@ class AdmissionController:
         obs_metrics.counter("serving/admission_deferrals").inc()
         self._alarm_once(reason)
 
-    def note_refusal(self, reason: str) -> None:
-        """A request was shed outright — alarm, but do NOT count a deferral
-        (deferrals measure waiting, refusals measure dropped load; one event
-        must not inflate both)."""
+    def note_refusal(self, reason: str, kind: str = "other") -> None:
+        """A request was shed outright — count the refusal under its
+        machine-readable class (`serving/refused_queue_overflow`, ...) and
+        alarm, but do NOT count a deferral (deferrals measure waiting,
+        refusals measure dropped load; one event must not inflate both)."""
+        obs_metrics.counter(f"serving/refused_{kind}").inc()
         self._alarm_once(reason)
 
     def note_flow(self) -> None:

@@ -709,6 +709,12 @@ FAULT_KINDS = (
     #                       control must degrade to queueing/refusals, not
     #                       OOM.  No-op under the training CLIs (the engine
     #                       polls take_flood_fault; at_step ignores it).
+    "kill-replica",       # fleet drill: kill replica IDX of a serving fleet
+    #                       at fleet iteration N (`kill-replica@STEP:IDX`,
+    #                       default replica 0) — the router must drain and
+    #                       requeue its in-flight requests onto survivors
+    #                       and keep serving.  No-op under the training CLIs
+    #                       (serving/fleet.py polls take_kill_replica_fault).
 )
 
 
@@ -722,13 +728,14 @@ class Fault:
 def parse_fault(spec: str) -> Fault:
     """`KIND@STEP` (e.g. `kill-process@40`); STEP defaults to 0.  stall-data
     accepts `stall-data@STEP:SECONDS`; flood accepts `flood@STEP:COUNT`
-    (burst size, stored in the same numeric slot)."""
+    (burst size, stored in the same numeric slot); kill-replica accepts
+    `kill-replica@STEP:IDX` (the fleet replica to kill, default 0)."""
     kind, _, at = spec.partition("@")
     if kind not in FAULT_KINDS:
         raise ValueError(
             f"unknown fault kind {kind!r}; choose from {', '.join(FAULT_KINDS)}"
         )
-    stall_s = 32.0 if kind == "flood" else 5.0
+    stall_s = 32.0 if kind == "flood" else 0.0 if kind == "kill-replica" else 5.0
     if ":" in at:
         at, _, secs = at.partition(":")
         stall_s = float(secs)  # host-sync-ok: parsing a CLI flag string
@@ -824,6 +831,19 @@ def take_flood_fault(step: int) -> int:
         # flood@STEP:0 is a deliberate no-burst control and stays 0
         return int(inj.fault.stall_s)  # host-sync-ok: parsed CLI number
     return 0
+
+
+def take_kill_replica_fault(step: int) -> Optional[int]:
+    """The replica index to kill (None = no fault) exactly once when a
+    `kill-replica` fault is armed and the serving FLEET's iteration counter
+    reaches the fault step — serving/fleet.py polls this and drains/requeues
+    that replica's in-flight requests onto the survivors."""
+    inj = _ACTIVE_INJECTOR
+    if (inj is not None and not inj.fired and inj.fault.kind == "kill-replica"
+            and step >= inj.fault.step):
+        inj.fired = True
+        return int(inj.fault.stall_s)  # host-sync-ok: parsed CLI number
+    return None
 
 
 def take_stream_fault() -> bool:

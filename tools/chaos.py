@@ -121,6 +121,24 @@ def main(argv=None) -> int:
     p.add_argument("--max_queue", type=int, default=4)
     p.add_argument("--workdir", type=str, default=None)
 
+    p = sub.add_parser(
+        "kill-replica",
+        help="serving fleet preemption drill: 2 replicas under Poisson "
+             "load, kill one mid-run via kill-replica@ITER:IDX; every "
+             "accepted request must complete on the survivors (requeued, "
+             "zero drops) with ONE replica_lost alarm")
+    p.add_argument("--requests", type=int, default=6,
+                   help="organic Poisson requests")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--at", type=int, default=4,
+                   help="fleet iteration the kill fires at")
+    p.add_argument("--victim", type=int, default=0,
+                   help="replica index to kill")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="also run the drill with prefill/decode split")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--workdir", type=str, default=None)
+
     args = parser.parse_args(argv)
     if args.cmd == "corrupt":
         corrupt_file(args.path, nbytes=args.nbytes)
@@ -154,6 +172,12 @@ def main(argv=None) -> int:
         return flood_drill(
             requests=args.requests, burst=args.burst, at=args.at,
             slots=args.slots, max_queue=args.max_queue, workdir=args.workdir,
+        )
+    elif args.cmd == "kill-replica":
+        return kill_replica_drill(
+            requests=args.requests, replicas=args.replicas, at=args.at,
+            victim=args.victim, disaggregate=args.disaggregate,
+            slots=args.slots, workdir=args.workdir,
         )
     return 0
 
@@ -311,6 +335,117 @@ def flood_drill(requests=4, burst=16, at=2, slots=2, max_queue=4,
           f"{report.get('synthetic_completed', 0)} of the burst served, "
           f"{report.get('refused_total'):.0f} total refusals "
           f"(p99 TTFT {report.get('ttft_p99_s'):.3f}s) — no OOM, no crash")
+    return 0
+
+
+def kill_replica_drill(requests=6, replicas=2, at=4, victim=0,
+                       disaggregate=False, slots=2, workdir=None,
+                       timeout=600) -> int:
+    """Serving fleet preemption drill: run the serve CLI with `--replicas N`
+    under Poisson load and `--inject_fault kill-replica@AT:VICTIM`, then
+    verify serve-through-preemption — every accepted request completes on
+    the survivors (drained + requeued, ZERO silent drops), exactly one
+    `replica_lost` alarm lands in the telemetry stream, request records are
+    replica-tagged, and the report still carries a finite p99 TTFT.
+    Returns 0 on success."""
+    import json
+    import subprocess
+    import tempfile
+
+    cwd = Path(workdir) if workdir else Path(tempfile.mkdtemp(prefix="killrep_"))
+    cwd.mkdir(parents=True, exist_ok=True)
+    report_path = cwd / "kill_replica_report.json"
+    tele_dir = cwd / "tele"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    print(f"[kill-replica] serve CLI: {requests} Poisson requests across "
+          f"{replicas} replicas, killing replica {victim} at fleet "
+          f"iteration {at}"
+          + (" (disaggregated prefill)" if disaggregate else "")
+          + f"; workdir {cwd}")
+    r = subprocess.run(
+        [sys.executable, "-m", "dalle_pytorch_tpu.cli.serve",
+         "--synthetic", "--dim", "32", "--depth", "2", "--heads", "2",
+         "--dim_head", "8", "--text_seq_len", "8", "--num_text_tokens", "64",
+         "--num_image_tokens", "32", "--image_fmap_size", "4",
+         "--loadgen", str(requests), "--rate", "20", "--streams", "2",
+         "--slots", str(slots), "--block_size", "8", "--no_vae",
+         "--replicas", str(replicas),
+         *(["--disaggregate"] if disaggregate else []),
+         "--inject_fault", f"kill-replica@{at}:{victim}",
+         "--telemetry", str(tele_dir), "--telemetry_every", "4",
+         "--report_json", str(report_path)],
+        cwd=str(cwd), env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        print(f"[kill-replica] FAIL: serve rc={r.returncode}\n"
+              f"{r.stderr[-2000:]}")
+        return 1
+    report = json.loads(report_path.read_text())
+    # zero drops: every organic arrival is either completed (possibly as a
+    # requeued reincarnation on a survivor) or a counted refusal
+    done = report["requests_completed"]
+    refused = report["requests_refused"]
+    if done + refused < requests:
+        print(f"[kill-replica] FAIL: {done} completed + {refused} refused < "
+              f"{requests} arrivals — requests were silently dropped\n"
+              f"{r.stdout[-2000:]}")
+        return 1
+    if report.get("replicas_lost", 0) != 1:
+        print(f"[kill-replica] FAIL: expected 1 replica lost, report says "
+              f"{report.get('replicas_lost')}")
+        return 1
+    if report.get("replicas_alive") != replicas - 1:
+        print(f"[kill-replica] FAIL: {report.get('replicas_alive')} alive "
+              f"!= {replicas - 1}")
+        return 1
+    if report.get("ttft_p99_s") is None or report.get(
+            "images_per_sec_per_chip") in (None, 0):
+        print("[kill-replica] FAIL: the post-kill report lost its SLO "
+              "columns (no p99 TTFT / throughput)")
+        return 1
+    if disaggregate and not report.get("handoff_requests"):
+        print("[kill-replica] FAIL: disaggregated run recorded no prefill "
+              "handoffs")
+        return 1
+
+    # --- telemetry assertions: ONE replica_lost alarm, replica-tagged
+    # request records, and a terminal record for every arrival -------------
+    spans_path = tele_dir / "serve.spans.jsonl"
+    records = [json.loads(ln) for ln in spans_path.read_text().splitlines()
+               if ln.strip()]
+    lost = [rec for rec in records if rec.get("kind") == "alarm"
+            and rec.get("type") == "replica_lost"]
+    if len(lost) != 1:
+        print(f"[kill-replica] FAIL: expected exactly 1 replica_lost alarm, "
+              f"got {len(lost)}")
+        return 1
+    if lost[0].get("replica") != victim:
+        print(f"[kill-replica] FAIL: alarm blames replica "
+              f"{lost[0].get('replica')}, not the victim {victim}")
+        return 1
+    req_recs = [rec for rec in records if rec.get("kind") == "request"]
+    tagged = {rec.get("replica") for rec in req_recs if "replica" in rec}
+    if len(tagged) < 2:
+        print(f"[kill-replica] FAIL: request records name replicas {tagged} "
+              f"— expected records from at least 2 replicas")
+        return 1
+    deferred = [rec for rec in req_recs if rec.get("outcome") == "deferred"
+                and rec.get("requeued")]
+    if len(deferred) != lost[0].get("requeued", -1):
+        print(f"[kill-replica] FAIL: {len(deferred)} deferred/requeued "
+              f"records != alarm's requeued={lost[0].get('requeued')}")
+        return 1
+    print(f"[kill-replica] obs OK: 1 replica_lost alarm (replica {victim}, "
+          f"{lost[0].get('requeued')} requeued), records from replicas "
+          f"{sorted(tagged)}, {len(deferred)} drain records")
+    print(f"[kill-replica] OK: {done} completed + {refused} refused "
+          f"(all {requests} accounted for), "
+          f"{report.get('requeued_total', 0):.0f} requeued onto survivors, "
+          f"p99 TTFT {report['ttft_p99_s']:.3f}s — zero drops, no crash")
     return 0
 
 

@@ -69,7 +69,9 @@ JIT_PURE = (
     # the serving engine's jitted admit/decode bodies must stay sync-free
     # (one stray sync there stalls EVERY in-flight request each step); the
     # scheduler's deliberate host work — TTFT blocking, pulling finished
-    # codes, CLI scalars — is waived line-by-line
+    # codes, CLI scalars — is waived line-by-line.  The directory target
+    # also covers router.py (placement must read only host-held load) and
+    # fleet.py (prefill handoff dispatch + drain/requeue bookkeeping)
     "dalle_pytorch_tpu/serving",
     # the SLO monitor runs on the engine's poll thread at window cadence —
     # it must stay pure host arithmetic over the metrics registry (it never

@@ -3,6 +3,7 @@
 
     python tools/serving_report.py /tmp/tele/serve.spans.jsonl
     python tools/serving_report.py /tmp/tele           # picks *.spans.jsonl
+    python tools/serving_report.py /tmp/r0 /tmp/r1     # merge fleet streams
 
 Sections, all from the stream serving/engine.py writes:
 
@@ -18,7 +19,11 @@ Sections, all from the stream serving/engine.py writes:
 * **engine windows** (`kind:"serving_window"`) — queue depth, lanes, pool
   occupancy, goodput, and the poll-loop admit/dispatch/block/evict split;
 * **SLO windows** (`kind:"slo_window"`) + burn-rate / backpressure alarms
-  and the refusal/deferral counters from metric snapshots.
+  and the refusal/deferral counters from metric snapshots;
+* **fleet** — when request records carry a `replica` tag (serving/fleet.py
+  runs), a per-replica outcome/latency breakdown plus the `replica_lost`
+  drain/requeue story.  Multiple paths merge into one report (per-replica
+  telemetry dirs, or one combined stream).
 
 Pure stdlib; works on a partially-written file from a live run."""
 from __future__ import annotations
@@ -86,6 +91,36 @@ def _waterfall(done: List[Dict[str, Any]], max_rows: int,
     return out
 
 
+def _fleet_table(reqs: List[Dict[str, Any]],
+                 lost: List[Dict[str, Any]]) -> List[str]:
+    """Per-replica breakdown (only when records carry a `replica` tag) plus
+    the preemption story: which replica died, how much was requeued."""
+    by_rep: Dict[Any, List[Dict[str, Any]]] = {}
+    for r in reqs:
+        if "replica" in r:
+            by_rep.setdefault(r["replica"], []).append(r)
+    if not by_rep and not lost:
+        return []
+    out = ["", f"fleet ({len(by_rep)} replicas seen in request records):"]
+    if by_rep:
+        out.append("  replica  completed  shed  deferred  lat_p50    lat_p99")
+        for rep in sorted(by_rep):
+            rs = by_rep[rep]
+            done = [r for r in rs if r.get("outcome", "completed") == "completed"]
+            shed = sum(1 for r in rs if r.get("outcome") == "shed")
+            defer = sum(1 for r in rs if r.get("outcome") == "deferred")
+            lats = [r["latency_s"] for r in done
+                    if r.get("latency_s") is not None]
+            out.append(f"  {rep!s:>7} {len(done):>10} {shed:>5} {defer:>9} "
+                       f"{_ms(_pct(lats, 0.50)):>8} {_ms(_pct(lats, 0.99)):>10}")
+    for a in lost:
+        out.append(f"  replica_lost: replica {a.get('replica')} "
+                   f"({a.get('reason', '?')}) — {a.get('requeued', 0)} "
+                   f"requests requeued onto {a.get('survivors', '?')} "
+                   f"survivor(s)")
+    return out
+
+
 def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     reqs = [r for r in records
             if r.get("kind") in ("request", "serving_request")]
@@ -95,6 +130,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
               and r.get("type") == "serving_backpressure"]
     slo_alarms = [r for r in records if r.get("kind") == "alarm"
                   and r.get("type") == "slo_burn_rate"]
+    lost_alarms = [r for r in records if r.get("kind") == "alarm"
+                   and r.get("type") == "replica_lost"]
 
     out: List[str] = []
     # legacy serving_request records carry no outcome: they were only ever
@@ -129,6 +166,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     else:
         out.append("no request records — did the run route through "
                    "the engine with telemetry active?")
+
+    out.extend(_fleet_table(reqs, lost_alarms))
 
     if windows:
         out.append("")
@@ -182,8 +221,11 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
         if r.get("kind") != "metrics":
             continue
         for name in ("serving/submitted", "serving/admitted", "serving/refused",
+                     "serving/refused_queue_overflow", "serving/refused_never_fits",
                      "serving/admission_deferrals", "serving/completed",
-                     "serving/flood_injected"):
+                     "serving/flood_injected", "serving/drained",
+                     "serving/handoff_requests", "serving/handoff_bytes",
+                     "router/requeued", "router/shed", "router/replicas_lost"):
             rec = (r.get("metrics") or {}).get(name)
             if rec and rec.get("total") is not None:
                 counters[name] = rec["total"]
@@ -197,18 +239,25 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("path", help="spans JSONL file or telemetry dir")
+    parser.add_argument("paths", nargs="+", metavar="path",
+                        help="spans JSONL files or telemetry dirs; several "
+                             "merge into one report (fleet replicas)")
     parser.add_argument("--max_rows", type=int, default=20)
     args = parser.parse_args(argv)
 
-    p = Path(args.path)
-    if p.is_dir():
-        candidates = sorted(p.glob("*.spans.jsonl"))
-        if not candidates:
-            print(f"no *.spans.jsonl under {p}")
-            return 1
-        p = candidates[-1]
-    print(build_report(load_records(p), max_rows=args.max_rows))
+    records: List[Dict[str, Any]] = []
+    for path in args.paths:
+        p = Path(path)
+        if p.is_dir():
+            candidates = sorted(p.glob("*.spans.jsonl"))
+            if not candidates:
+                print(f"no *.spans.jsonl under {p}")
+                return 1
+            p = candidates[-1]
+        records.extend(load_records(p))
+    # one merged timeline: fleet replicas each stamp ts at write time
+    records.sort(key=lambda r: r.get("ts") or 0.0)
+    print(build_report(records, max_rows=args.max_rows))
     return 0
 
 
