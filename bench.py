@@ -609,6 +609,72 @@ def run_bench() -> dict:
     except Exception as e:  # the fleet row must never sink the bench
         serving_fleet_row = {"error": str(e)[:200]}
 
+    # quantized serving row (ISSUE 13): the SAME Poisson load against an
+    # int8-weights + int8-KV engine at DOUBLE the slot count — the capacity
+    # the byte savings buy.  p50/p99 TTFT and images/sec/chip sit next to
+    # the bf16 `serving` row so the tradeoff (more lanes vs dequant
+    # overhead per step) is measured, not asserted.
+    quantized_serving_row = None
+    try:
+        from dalle_pytorch_tpu import quantization as quant_mod
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+        PoissonLoadGen, synthetic_request_maker = _import_loadgen()
+
+        qplain = gen_params if on_tpu else state.params
+        qparams = quant_mod.quantize_tree(qplain, "int8")
+        q_engine = GenerationEngine(
+            qparams, cfg,
+            engine_cfg=EngineConfig(num_slots=4,  # 2x the bf16 serving row
+                                    block_size=64 if on_tpu else 16,
+                                    quantize_kv="int8"),
+        )
+        q_gen = PoissonLoadGen(4, rate=2.0 if on_tpu else 5.0, streams=2, seed=0)
+        quantized_serving_row = q_gen.run(
+            q_engine, synthetic_request_maker(cfg, seed=0),
+            max_wall_s=600 if on_tpu else 300,
+        )
+        quantized_serving_row["paged_pool_mb"] = round(
+            q_engine.pool.bytes(2 if on_tpu else 4) / 1e6, 2)
+        quantized_serving_row["slots"] = 4
+        quantized_serving_row["weight_reduction"] = round(
+            quant_mod.weight_reduction(qplain, qparams), 4)
+        quantized_serving_row["kv_pool_reduction"] = round(
+            quant_mod.kv_pool_reduction(cfg.dim_head), 4)
+        quantized_serving_row["quantization"] = q_engine.quantization_state()
+    except Exception as e:  # must never sink the bench
+        quantized_serving_row = {"error": str(e)[:200]}
+
+    # quantized parity row (ISSUE 13): the NUMERICS gate for the row above.
+    # Greedy paged decode on the same text, bf16/f32 params vs int8 weights
+    # + int8 KV, drift measured relative to the baseline logits' spread.
+    # `within_budget` is what `--gate` checks — capacity wins that cost
+    # correctness would be regressions, not improvements.
+    quantized_parity_row = None
+    try:
+        from dalle_pytorch_tpu import quantization as quant_mod
+
+        pplain = gen_params if on_tpu else state.params
+        pq = quant_mod.quantize_tree(pplain, "int8")
+        ptext = jax.random.randint(
+            jax.random.PRNGKey(5), (1, cfg.text_seq_len), 1, cfg.num_text_tokens)
+        psteps = 64 if on_tpu else 24
+        base = quant_mod.paged_greedy_logits(pplain, cfg, ptext, steps=psteps)
+        quant = quant_mod.paged_greedy_logits(
+            pq, cfg, ptext, quantize_kv_mode="int8", steps=psteps)
+        parity = quant_mod.greedy_parity_metrics(base, quant)
+        quantized_parity_row = {
+            **{k: round(float(v), 6) for k, v in parity.items()},
+            "steps": psteps,
+            "rel_budget": quant_mod.FULL_PARITY_REL_BUDGET,
+            "within_budget": bool(
+                parity["greedy_logit_drift_rel"]
+                <= quant_mod.FULL_PARITY_REL_BUDGET),
+        }
+    except Exception as e:  # must never sink the bench
+        quantized_parity_row = {"error": str(e)[:200]}
+
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
     # kept as a secondary row for cross-round continuity.  Each row runs as a
@@ -745,6 +811,8 @@ def run_bench() -> dict:
         "memory": memory_row,
         "serving": serving_row,
         "serving_fleet": serving_fleet_row,
+        "quantized_serving": quantized_serving_row,
+        "quantized_parity": quantized_parity_row,
         "sparse_attention": sparse_attention_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
@@ -816,6 +884,16 @@ GATE_SPECS = {
     # enough to catch serve-through-preemption falling off a cliff
     "serving_fleet.kill_one.ttft_p99_s": ("lower", 1.0),
     "serving_fleet.kill_one.images_per_sec_per_chip": ("higher", 0.75),
+    # quantized serving runs 2x the slots of the bf16 row: throughput and
+    # tail latency gate against their own baseline, same tolerances as the
+    # bf16 serving row
+    "quantized_serving.ttft_p99_s": ("lower", 0.5),
+    "quantized_serving.images_per_sec_per_chip": ("higher", 0.5),
+    # the numerics gate: greedy logit drift vs bf16 must not grow (tol 1.0
+    # absorbs seed-level jitter; the hard budget is asserted in the row
+    # itself via within_budget), and greedy token agreement must hold
+    "quantized_parity.greedy_logit_drift_rel": ("lower", 1.0),
+    "quantized_parity.token_match_frac": ("higher", 0.05),
     "health_overhead.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),
@@ -890,6 +968,17 @@ def run_gate(result: dict, baseline_path: str, gate: bool,
     baseline_metrics = entry.get("metrics") or {}
 
     cmp = gate_compare(result, baseline_metrics)
+    # the parity budget is ABSOLUTE, not relative-to-baseline: a quantized
+    # run whose greedy logit drift blew its declared budget fails the gate
+    # even on a first run with no baseline yet
+    parity = result.get("quantized_parity")
+    if isinstance(parity, dict) and parity.get("within_budget") is False:
+        cmp["regressions"].append({
+            "metric": "quantized_parity.within_budget",
+            "candidate": parity.get("greedy_logit_drift_rel"),
+            "baseline": parity.get("rel_budget"),
+            "ratio": None, "direction": "lower",
+            "rel_tol": 0.0})
     for rec in cmp["checked"]:
         tag = ("REGRESSION" if rec in cmp["regressions"]
                else "improved" if rec in cmp["improvements"] else "ok")

@@ -81,6 +81,14 @@ def build_parser():
     eng.add_argument("--telemetry_every", type=int, default=32,
                      help="poll iterations per serving telemetry window "
                           "(serving_window events, SLO evaluation, status_json)")
+    eng.add_argument("--quantize_weights", choices=["none", "int8", "fp8"],
+                     default="none",
+                     help="post-training weight quantization applied to the "
+                          "loaded params (quantization.quantize_tree)")
+    eng.add_argument("--quantize_kv", choices=["none", "int8"],
+                     default="none",
+                     help="store the paged KV pool quantized (int8 blocks + "
+                          "per-token scales)")
     eng.add_argument("--replicas", type=int, default=1,
                      help="engine replicas behind the load-balancing router "
                           "(serving/fleet.py); killing one mid-run drains + "
@@ -191,12 +199,25 @@ def main(argv=None):
     dalle_cfg, params, vae_cfg, vae_params = _build_model(args)
     if args.no_vae:
         vae_cfg = vae_params = None
+    if args.quantize_weights != "none":
+        from dalle_pytorch_tpu import quantization as quant_mod
+
+        if quant_mod.tree_is_quantized(params):
+            print("[serving] checkpoint weights already quantized "
+                  f"({quant_mod.weight_quant_kind(params)})")
+        else:
+            plain = params
+            params = quant_mod.quantize_tree(params, args.quantize_weights)
+            print(f"[serving] weights quantized to {args.quantize_weights}: "
+                  f"{quant_mod.weight_reduction(plain, params):.2f}x at-rest "
+                  "reduction vs bf16 storage")
 
     engine_cfg = EngineConfig(
         num_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_queue=args.max_queue,
         headroom_frac=args.headroom_frac, filter_thres=args.top_k,
         telemetry_every=args.telemetry_every,
+        quantize_kv=None if args.quantize_kv == "none" else args.quantize_kv,
     )
     if args.replicas > 1 or args.disaggregate:
         from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet

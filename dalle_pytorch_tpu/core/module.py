@@ -51,7 +51,12 @@ def linear_init(key, in_dim: int, out_dim: int, bias: bool = True):
 
 
 def linear(params, x):
-    y = jnp.dot(x, params["w"], preferred_element_type=x.dtype)
+    w = params["w"]
+    if isinstance(w, dict):  # {"qvalue","scale"} from quantization.quantize_tree
+        from dalle_pytorch_tpu.quantization import maybe_dequant_weight
+
+        w = maybe_dequant_weight(w, x.dtype)
+    y = jnp.dot(x, w, preferred_element_type=x.dtype)
     if "b" in params:
         y = y + params["b"].astype(y.dtype)
     return y
@@ -85,7 +90,12 @@ def embedding_init(key, num_embeddings: int, dim: int):
 
 
 def embedding(params, ids):
-    return jnp.take(params["table"], ids, axis=0)
+    table = params["table"]
+    if isinstance(table, dict):  # {"qvalue","scale"} from quantization.quantize_tree
+        from dalle_pytorch_tpu.quantization import maybe_dequant_weight
+
+        table = maybe_dequant_weight(table)
+    return jnp.take(table, ids, axis=0)
 
 
 # ---------------------------------------------------------------------------

@@ -198,16 +198,26 @@ def init_dalle(key: jax.Array, cfg: DALLEConfig) -> dict:
 # embedding helpers (shared with the sampler)
 # ---------------------------------------------------------------------------
 
+def _logits_w(params: dict) -> jnp.ndarray:
+    from dalle_pytorch_tpu.quantization import maybe_dequant_weight
+
+    return maybe_dequant_weight(params["logits_linear"]["w"])
+
+
 def _text_table(params: dict, cfg: DALLEConfig) -> jnp.ndarray:
     if cfg.share_input_output_emb:
-        return params["logits_linear"]["w"][:, : cfg.num_text_tokens_padded].T
-    return params["text_emb"]["table"]
+        return _logits_w(params)[:, : cfg.num_text_tokens_padded].T
+    from dalle_pytorch_tpu.quantization import maybe_dequant_weight
+
+    return maybe_dequant_weight(params["text_emb"]["table"])
 
 
 def _image_table(params: dict, cfg: DALLEConfig) -> jnp.ndarray:
     if cfg.share_input_output_emb:
-        return params["logits_linear"]["w"][:, cfg.num_text_tokens_padded :].T
-    return params["image_emb"]["table"]
+        return _logits_w(params)[:, cfg.num_text_tokens_padded :].T
+    from dalle_pytorch_tpu.quantization import maybe_dequant_weight
+
+    return maybe_dequant_weight(params["image_emb"]["table"])
 
 
 def remap_and_bos(cfg: DALLEConfig, text: jnp.ndarray) -> jnp.ndarray:

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.quantization import weight_dtype as _weight_dtype
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.models.dalle import DALLEConfig
@@ -92,7 +93,7 @@ def _prefill_phase(
         )
     n_pre = tokens.shape[1]
 
-    cache = init_cache(tcfg, bb, dtype=params["logits_linear"]["w"].dtype)
+    cache = init_cache(tcfg, bb, dtype=_weight_dtype(params))
     out, cache = prefill(params["transformer"], tcfg, tokens, cache)
     last_logits = _logits_at(params, cfg, out[:, -1:], n_pre - 1)
     return cache, last_logits
@@ -554,7 +555,7 @@ def _generate_texts_cached(
             sk, top_k_filter(lg, thres=filter_thres), temperature=temperature
         ).astype(jnp.int32)
 
-    cache = init_cache(tcfg, b, dtype=params["logits_linear"]["w"].dtype)
+    cache = init_cache(tcfg, b, dtype=_weight_dtype(params))
     out, cache = prefill(params["transformer"], tcfg, embed(text, 0), cache)
 
     key, sk = jax.random.split(key)

@@ -1009,20 +1009,49 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset,
     the pattern row, so the step gathers Kmax keys instead of attending over
     the full seq_len cache.  Padded gather slots are masked off by counts
     (their exp underflows to exactly 0.0, like the dense path's masked
-    positions), so results match the full-cache row-mask path."""
+    positions), so results match the full-cache row-mask path.
+
+    A QUANTIZED cache (`k_scale`/`v_scale` present: int8 k/v + per-token
+    scales — the serving pool's dense per-slot view) runs the same math on
+    dequantized values.  The new column is quantized once on write, and the
+    sparse-decode branch dequantizes ONLY the gathered Kmax keys, so the
+    dtype win compounds with PR 8's pattern win instead of undoing it."""
+    from dalle_pytorch_tpu.quantization import (
+        dequantize_kv as _deq_kv,
+        quantize_kv as _q_kv,
+    )
+
     ang = (
         None if rotary is None
         else jax.lax.dynamic_slice(rotary, (offset, 0), (1, rotary.shape[1]))
     )
     q, k, v = _qkv_heads(shared, cfg, x, ang)  # (b, h, 1, dh)
     q = q * (cfg.dim_head ** -0.5)
+    cdtype = q.dtype
 
-    k_buf = jax.lax.dynamic_update_slice(
-        layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, offset, 0)
-    )
-    v_buf = jax.lax.dynamic_update_slice(
-        layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, offset, 0)
-    )
+    quantized = "k_scale" in layer_cache
+    if quantized:
+        kq, ks = _q_kv(k)
+        vq, vs = _q_kv(v)
+        k_buf = jax.lax.dynamic_update_slice(
+            layer_cache["k"], kq, (0, 0, offset, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            layer_cache["v"], vq, (0, 0, offset, 0))
+        ks_buf = jax.lax.dynamic_update_slice(
+            layer_cache["k_scale"], ks.astype(layer_cache["k_scale"].dtype),
+            (0, 0, offset))
+        vs_buf = jax.lax.dynamic_update_slice(
+            layer_cache["v_scale"], vs.astype(layer_cache["v_scale"].dtype),
+            (0, 0, offset))
+        new_cache = (k_buf, v_buf, ks_buf, vs_buf)
+    else:
+        k_buf = jax.lax.dynamic_update_slice(
+            layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, offset, 0)
+        )
+        v_buf = jax.lax.dynamic_update_slice(
+            layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, offset, 0)
+        )
+        new_cache = (k_buf, v_buf)
 
     if decode_tab is not None:
         idx, counts = decode_tab
@@ -1034,16 +1063,24 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset,
                 counts, (0, offset), (counts.shape[0], 1))[:, 0]  # (h,)
             k_sel = jnp.take_along_axis(k_buf, sel[None, :, :, None], axis=2)
             v_sel = jnp.take_along_axis(v_buf, sel[None, :, :, None], axis=2)
+            if quantized:  # dequantize only the Kmax gathered keys
+                k_sel = _deq_kv(k_sel, jnp.take_along_axis(
+                    ks_buf, sel[None, :, :], axis=2), cdtype)
+                v_sel = _deq_kv(v_sel, jnp.take_along_axis(
+                    vs_buf, sel[None, :, :], axis=2), cdtype)
             amask = (jnp.arange(kmax)[None, :] < cnt[:, None])[None, :, None, :]
         else:  # shared (n, Kmax)
             sel = jax.lax.dynamic_slice(idx, (offset, 0), (1, kmax))[0]
             cnt = jax.lax.dynamic_slice(counts, (offset,), (1,))[0]
             k_sel = jnp.take(k_buf, sel, axis=2)
             v_sel = jnp.take(v_buf, sel, axis=2)
+            if quantized:
+                k_sel = _deq_kv(k_sel, jnp.take(ks_buf, sel, axis=2), cdtype)
+                v_sel = _deq_kv(v_sel, jnp.take(vs_buf, sel, axis=2), cdtype)
             amask = (jnp.arange(kmax) < cnt)[None, None, None, :]
         out = attend(q, k_sel, v_sel, mask=amask, stable=cfg.stable)
         out = linear(shared["out"], _merge_heads(out))
-        return out, (k_buf, v_buf)
+        return out, new_cache
 
     j = jnp.arange(cfg.seq_len)
     mask = j <= offset
@@ -1057,9 +1094,14 @@ def _attention_cached(shared, cfg, layer_cache, x, pattern, rotary, offset,
             row = jax.lax.dynamic_slice(pattern, (offset, 0), (1, cfg.seq_len))[0]
             mask = mask & row
     amask = mask[None, :, None, :] if mask.ndim == 2 else mask[None, None, None, :]
-    out = attend(q, k_buf, v_buf, mask=amask, stable=cfg.stable)
+    if quantized:
+        k_att = _deq_kv(k_buf, ks_buf, cdtype)
+        v_att = _deq_kv(v_buf, vs_buf, cdtype)
+    else:
+        k_att, v_att = k_buf, v_buf
+    out = attend(q, k_att, v_att, mask=amask, stable=cfg.stable)
     out = linear(shared["out"], _merge_heads(out))
-    return out, (k_buf, v_buf)
+    return out, new_cache
 
 
 def _run_cached_layers(cfg: TransformerConfig, specs, x, cache, branch):
@@ -1280,15 +1322,31 @@ def paged_blocks_per_seq(cfg: TransformerConfig, block_size: int) -> int:
 
 
 def init_paged_pool(
-    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.float32
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=jnp.float32,
+    quantize: Optional[str] = None,
 ) -> dict:
     """One shared KV block pool: per layer, (num_blocks, heads, block_size,
     dim_head) k/v arrays (stacked along a leading depth axis under
     scan_layers, mirroring init_cache).  Block 0 is conventionally reserved
-    by the serving pool as the trash block inactive slots write into."""
+    by the serving pool as the trash block inactive slots write into.
+
+    `quantize="int8"` stores int8 k/v with PER-TOKEN bf16 scales beside the
+    blocks (`k_scale`/`v_scale`, block shape minus dim_head) — per-token so
+    the decode scatter of one new column never re-scales a block's existing
+    tokens.  Every paged op downstream keys off the presence of the scale
+    arrays, so the quantized pool threads through the same jits."""
+    from dalle_pytorch_tpu.quantization import KV_SCALE_DTYPE
 
     def entry(lead=()):
         shape = (*lead, num_blocks, cfg.heads, block_size, cfg.dim_head)
+        if quantize and quantize != "none":
+            sshape = shape[:-1]
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, KV_SCALE_DTYPE),
+                "v_scale": jnp.zeros(sshape, KV_SCALE_DTYPE),
+            }
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     if cfg.scan_layers:
@@ -1334,7 +1392,14 @@ def write_prefill_to_pool(
     the block pool — prefill itself runs the existing `prefill` (identical
     math, so parity is free) and this is pure data movement.  `block_tables`:
     (b, max_blocks) physical block ids for the b newly admitted slots;
-    `cache_layers`: the `layers` entry of the cache `prefill` returned."""
+    `cache_layers`: the `layers` entry of the cache `prefill` returned.
+
+    Quantized pools (layer entries carrying `k_scale`) accept EITHER a
+    dense float cache (the fused admit: quantize at scatter) or a
+    pre-quantized handoff (the disaggregated worker compressed the wire
+    bytes already) — per-token scales make the two orders bit-identical."""
+    from dalle_pytorch_tpu.quantization import quantize_kv as _quantize_kv
+
     nb = -(-n_pre // block_size)
     pad = nb * block_size - n_pre
 
@@ -1348,22 +1413,43 @@ def write_prefill_to_pool(
         k = k.reshape(*lead, b, h, nb, block_size, dh)
         return jnp.swapaxes(k, -4, -3)
 
+    def pack_scale(s):
+        # (..., b, h, seq) -> (..., b, nb, h, block_size)
+        s = s[..., :n_pre]
+        if pad:
+            s = jnp.pad(s, [(0, 0)] * (s.ndim - 1) + [(0, pad)])
+        *lead, b, h, _ = s.shape
+        s = s.reshape(*lead, b, h, nb, block_size)
+        return jnp.swapaxes(s, -3, -2)
+
+    def packed_kv(lp, lc):
+        """(k, v[, k_scale, v_scale]) in pool layout for one layer."""
+        if "k_scale" not in lp:
+            return {"k": pack(lc["k"]), "v": pack(lc["v"])}
+        if "k_scale" in lc:  # pre-quantized handoff: pure data movement
+            return {"k": pack(lc["k"]), "v": pack(lc["v"]),
+                    "k_scale": pack_scale(lc["k_scale"]),
+                    "v_scale": pack_scale(lc["v_scale"])}
+        kq, ks = _quantize_kv(pack(lc["k"]))
+        vq, vs = _quantize_kv(pack(lc["v"]))
+        return {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+
     tbl = block_tables[:, :nb]
     if cfg.scan_layers:
         lp = pool["layers"]
-        new_layers = dict(
-            lp,
-            k=lp["k"].at[:, tbl].set(pack(cache_layers["k"]).astype(lp["k"].dtype)),
-            v=lp["v"].at[:, tbl].set(pack(cache_layers["v"]).astype(lp["v"].dtype)),
-        )
+        pk = packed_kv(lp, cache_layers)
+        new_layers = dict(lp, **{
+            name: lp[name].at[(slice(None), tbl)].set(arr.astype(lp[name].dtype))
+            for name, arr in pk.items()
+        })
         return {"layers": new_layers}
     new_layers = []
     for lp, lc in zip(pool["layers"], cache_layers):
-        new_layers.append(dict(
-            lp,
-            k=lp["k"].at[tbl].set(pack(lc["k"]).astype(lp["k"].dtype)),
-            v=lp["v"].at[tbl].set(pack(lc["v"]).astype(lp["v"].dtype)),
-        ))
+        pk = packed_kv(lp, lc)
+        new_layers.append(dict(lp, **{
+            name: lp[name].at[tbl].set(arr.astype(lp[name].dtype))
+            for name, arr in pk.items()
+        }))
     return {"layers": new_layers}
 
 
@@ -1374,41 +1460,63 @@ def _paged_attention_step(shared, cfg, layer_pool, block_tables, offsets, x,
     blocks into a dense (h, seq_len, dh) view and runs the SAME
     `_attention_cached` math (vmapped), so results are bit-identical to the
     dense cache.  Returns (out (S, 1, dim), (new_k, new_v) (S, h, dh)) —
-    the new column, for the caller to scatter back into the pool."""
+    the new column, for the caller to scatter back into the pool.  On a
+    quantized pool the gathered view stays int8 (+ per-token scales) —
+    `_attention_cached` dequantizes on use — and the returned column tuple
+    grows the quantized column's scales ((S, h) each)."""
     seq = cfg.seq_len
+    quantized = "k_scale" in layer_pool
 
     def one(x_s, bt_s, off_s):
         k = jnp.take(layer_pool["k"], bt_s, axis=0)  # (B, h, bs, dh)
         v = jnp.take(layer_pool["v"], bt_s, axis=0)
         k = k.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
         v = v.transpose(1, 0, 2, 3).reshape(cfg.heads, -1, cfg.dim_head)[None, :, :seq]
-        out, (k2, v2) = _attention_cached(
-            shared, cfg, {"k": k, "v": v}, x_s[None], pattern, rotary, off_s,
+        cache = {"k": k, "v": v}
+        if quantized:
+            ks = jnp.take(layer_pool["k_scale"], bt_s, axis=0)  # (B, h, bs)
+            vs = jnp.take(layer_pool["v_scale"], bt_s, axis=0)
+            cache["k_scale"] = ks.transpose(1, 0, 2).reshape(cfg.heads, -1)[None, :, :seq]
+            cache["v_scale"] = vs.transpose(1, 0, 2).reshape(cfg.heads, -1)[None, :, :seq]
+        out, new_cache = _attention_cached(
+            shared, cfg, cache, x_s[None], pattern, rotary, off_s,
             decode_tab=decode_tab,
         )
-        new_k = jax.lax.dynamic_slice(
-            k2, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
-        new_v = jax.lax.dynamic_slice(
-            v2, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
-        return out[0], new_k[0, :, 0], new_v[0, :, 0]
 
-    out, nk, nv = jax.vmap(one)(x, block_tables, offsets)
-    return out, (nk, nv)
+        def col(buf):  # (1, h, seq[, dh]) -> the off_s column, batch removed
+            if buf.ndim == 4:
+                c = jax.lax.dynamic_slice(
+                    buf, (0, 0, off_s, 0), (1, cfg.heads, 1, cfg.dim_head))
+                return c[0, :, 0]
+            c = jax.lax.dynamic_slice(buf, (0, 0, off_s), (1, cfg.heads, 1))
+            return c[0, :, 0]
+
+        return (out[0], *[col(b) for b in new_cache])
+
+    res = jax.vmap(one)(x, block_tables, offsets)
+    return res[0], tuple(res[1:])
 
 
 def _paged_scatter_cols(layer_pool, block_tables, offsets, cols, block_size: int):
     """Write each slot's new KV column into its pool block.  Inactive slots
     share the trash block (their tables are all-zero), so their duplicate
     scatter indices can only clobber garbage."""
-    nk, nv = cols
     bids = jnp.take_along_axis(
         block_tables, (offsets // block_size)[:, None], axis=1)[:, 0]
     within = offsets % block_size
-    return dict(
+    nk, nv = cols[0], cols[1]
+    new = dict(
         layer_pool,
         k=layer_pool["k"].at[bids, :, within, :].set(nk.astype(layer_pool["k"].dtype)),
         v=layer_pool["v"].at[bids, :, within, :].set(nv.astype(layer_pool["v"].dtype)),
     )
+    if len(cols) == 4:  # quantized pool: scatter the column's per-token scales
+        nks, nvs = cols[2], cols[3]
+        new["k_scale"] = layer_pool["k_scale"].at[bids, :, within].set(
+            nks.astype(layer_pool["k_scale"].dtype))
+        new["v_scale"] = layer_pool["v_scale"].at[bids, :, within].set(
+            nvs.astype(layer_pool["v_scale"].dtype))
+    return new
 
 
 def _paged_shift_step(cfg, ring, x, offsets):

@@ -296,26 +296,33 @@ def dalle_step_comms(mesh: Union[Mapping[str, int], Any, None], params: Any,
 
 
 def prefill_handoff_bytes(tcfg: Any, n_pre: int, lanes: int = 1,
-                          itemsize: int = 4) -> float:
+                          itemsize: int = 4,
+                          kv_quant: Optional[str] = None) -> float:
     """Bytes of the prefill→decode KV handoff for ONE admission: the k + v
     prefix every layer carries, `lanes` sequences deep (a CFG-guided request
     hands over its [cond] and [null] prefixes).  This is the dense cache
     `write_prefill_to_pool` scatters — priced analytically so tests can
-    cross-check the figure against the actual handoff arrays' nbytes."""
-    return (2.0 * tcfg.depth * lanes * tcfg.heads * n_pre
-            * tcfg.dim_head * itemsize)
+    cross-check the figure against the actual handoff arrays' nbytes.  With
+    `kv_quant` the worker ships int8 payloads + per-token scales; the price
+    comes from the SAME `kv_bytes_per_elem` formula the memory ledger uses."""
+    from dalle_pytorch_tpu.quantization import kv_bytes_per_elem
+
+    return (2.0 * tcfg.depth * lanes * tcfg.heads * n_pre * tcfg.dim_head
+            * kv_bytes_per_elem(kv_quant, itemsize, tcfg.dim_head))
 
 
 def prefill_handoff_row(tcfg: Any, n_pre: int, lanes: int = 1,
                         itemsize: int = 4, ring_bytes: float = 0.0,
-                        admissions_per_step: float = 1.0) -> Dict[str, Any]:
+                        admissions_per_step: float = 1.0,
+                        kv_quant: Optional[str] = None) -> Dict[str, Any]:
     """The comms-ledger row for prefill/decode disaggregation: the wire
     bytes a prefill mesh ships to a decode replica per admission (KV prefix
     + the token-shift ring tails when shift_tokens is on).  Shaped like
     `step_comms_ledger`'s per_axis rows so fleet reports and
     `publish_gauges` treat it uniformly."""
-    payload = prefill_handoff_bytes(tcfg, n_pre, lanes, itemsize)
-    return {
+    payload = prefill_handoff_bytes(tcfg, n_pre, lanes, itemsize,
+                                    kv_quant=kv_quant)
+    row = {
         "axis": "handoff", "size": 2, "op": "prefill_to_decode",
         "bytes_per_step": (payload + ring_bytes) * admissions_per_step,
         "payload_bytes": payload,
@@ -323,6 +330,9 @@ def prefill_handoff_row(tcfg: Any, n_pre: int, lanes: int = 1,
         "n_pre": n_pre,
         "lanes": lanes,
     }
+    if kv_quant:
+        row["kv_quant"] = kv_quant
+    return row
 
 
 def publish_gauges(ledger: Mapping[str, Any], registry=None) -> None:

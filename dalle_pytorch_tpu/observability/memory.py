@@ -459,17 +459,36 @@ def sampling_memory_ledger(cfg: Any, batch: int, params: Any = None,
                 itemsize = _itemsize(leaves[0].dtype)
     rows = []
     if params is not None:
-        rows.append({"name": "params", "bytes": tree_float_bytes(params),
-                     "detail": "storage dtypes"})
+        from dalle_pytorch_tpu.quantization import (
+            tree_is_quantized,
+            tree_weight_bytes,
+        )
+
+        if tree_is_quantized(params):
+            rows.append({"name": "params", "bytes": tree_weight_bytes(params),
+                         "detail": "int8 matmul blocks + float scales/rest"})
+        else:
+            rows.append({"name": "params", "bytes": tree_float_bytes(params),
+                         "detail": "storage dtypes"})
     if paged_pool is not None:
         nb = int(paged_pool["num_blocks"])  # host-sync-ok: static pool geometry
         bs = int(paged_pool["block_size"])  # host-sync-ok: static pool geometry
         slots = int(paged_pool.get("num_slots", batch))
         isz = int(paged_pool.get("itemsize", itemsize))
-        pool_bytes = 2.0 * cfg.depth * nb * cfg.heads * bs * cfg.dim_head * isz
+        kv_quant = paged_pool.get("kv_quant")
+        if kv_quant:
+            from dalle_pytorch_tpu.quantization import kv_bytes_per_elem
+
+            bpe = kv_bytes_per_elem(kv_quant, isz, cfg.dim_head)
+            pool_bytes = 2.0 * cfg.depth * nb * cfg.heads * bs * cfg.dim_head * bpe
+            detail = (f"{nb} blocks x {bs} tok x 2 x depth x h x dh, "
+                      f"{kv_quant} + per-token scales (shared, at rest)")
+        else:
+            pool_bytes = 2.0 * cfg.depth * nb * cfg.heads * bs * cfg.dim_head * isz
+            detail = (f"{nb} blocks x {bs} tok x 2 x depth x h x dh "
+                      "(shared, at rest)")
         rows.append({"name": "paged_kv_pool", "bytes": pool_bytes,
-                     "detail": (f"{nb} blocks x {bs} tok x 2 x depth x h x dh "
-                                "(shared, at rest)")})
+                     "detail": detail})
         # the paged decode gathers ONE layer's dense view per slot at a time
         gather = 2.0 * slots * cfg.heads * cfg.total_seq_len * cfg.dim_head * isz
         rows.append({"name": "paged_gather", "bytes": gather,

@@ -122,6 +122,17 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
          note="vocab-sharded output projection"),
     Rule(r"w1/b|w1g/b|logits_linear/b", (AXIS_TP,), tp_only=True,
          note="biases of column/vocab-parallel projections"),
+    # int8 weight-quantization sidecars (quantization.quantize_tree): the 2-D
+    # .../w/qvalue blocks inherit the rules above (re.search matches the
+    # parent path), the 1-D per-output-channel scales get their own placement
+    Rule(r"(qkv/w|w1/w|w1g/w|logits_linear/w)/scale", (AXIS_TP,),
+         tp_only=True,
+         note="quant scales of column/vocab-parallel weights (out axis "
+              "shards with the qvalue blocks)"),
+    Rule(r"(?=.*shared_attn)(?=.*out/w/scale)|w2/w/scale", (None,),
+         tp_only=True,
+         note="quant scales of row-parallel weights: every tp rank holds "
+              "all output columns, so scales replicate"),
     Rule(r".*", LARGEST,
          note="default: largest divisible dim over the data axes"),
 )
@@ -320,10 +331,15 @@ class PartitionRegistry:
             if not hasattr(leaf, "ndim"):
                 continue
             dt = jnp.result_type(leaf)
-            if not jnp.issubdtype(dt, jnp.floating):
+            if jnp.issubdtype(dt, jnp.floating):
+                nbytes = leaf.size * (itemsize if itemsize is not None
+                                      else jnp.dtype(dt).itemsize)
+            elif dt == jnp.dtype(jnp.int8):
+                # quantized weight blocks are at-rest bytes too (1 byte/elem,
+                # never repriced: int8 is already the storage dtype)
+                nbytes = leaf.size * 1.0
+            else:
                 continue
-            nbytes = leaf.size * (itemsize if itemsize is not None
-                                  else jnp.dtype(dt).itemsize)
             spec = self.resolve(
                 _path_str(path), tuple(leaf.shape), axes,
                 zero_stage=zero_stage, tensor_parallel=tensor_parallel,

@@ -81,15 +81,20 @@ def reshard_preflight_ledger(
         _finish_ledger,
         tree_float_bytes,
     )
+    from dalle_pytorch_tpu.quantization import tree_is_quantized, tree_weight_bytes
 
     reg = registry if registry is not None else default_registry()
     axes = normalize_mesh_axes(mesh_or_axes)
     p_frac = reg.shard_fraction(
         params, axes, zero_stage, tensor_parallel=tensor_parallel)
+    quantized = tree_is_quantized(params)
     rows = [
         {"name": "params",
-         "bytes": tree_float_bytes(params) * p_frac,
-         "detail": f"storage x {p_frac:.4g} registry at-rest shard"},
+         "bytes": (tree_weight_bytes(params) if quantized
+                   else tree_float_bytes(params)) * p_frac,
+         "detail": (f"int8 blocks + scales x {p_frac:.4g} registry at-rest shard"
+                    if quantized else
+                    f"storage x {p_frac:.4g} registry at-rest shard")},
     ]
     if grad_itemsize is not None:
         rows.append(

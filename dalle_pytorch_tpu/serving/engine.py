@@ -80,6 +80,7 @@ class EngineConfig:
     headroom_frac: float = 0.92
     filter_thres: float = 0.9
     telemetry_every: int = 32  # poll iterations between serving_window events
+    quantize_kv: Optional[str] = None  # "int8" stores the KV pool quantized
 
 
 class GenerationEngine:
@@ -102,7 +103,12 @@ class GenerationEngine:
         self.n_pre = cfg.text_seq_len + 1  # bos + text (prime_len 0)
         self.n_gen = cfg.image_seq_len
 
-        ldtype = params["logits_linear"]["w"].dtype  # the init_cache convention
+        from dalle_pytorch_tpu.quantization import weight_dtype
+
+        ldtype = weight_dtype(params)  # the init_cache convention
+        kv_quant = engine_cfg.quantize_kv
+        if kv_quant == "none":
+            kv_quant = None
         self.pool = BlockPool(
             self.tcfg,
             engine_cfg.num_blocks
@@ -110,6 +116,7 @@ class GenerationEngine:
             else engine_cfg.num_slots * _blocks_per_seq(self.tcfg, engine_cfg.block_size),
             engine_cfg.block_size,
             dtype=ldtype,
+            quant=kv_quant,
         )
         self.queue = RequestQueue(max_depth=engine_cfg.max_queue)
         self.admission = AdmissionController(
@@ -820,6 +827,7 @@ class GenerationEngine:
                 phase_s=phases, goodput_frac=goodput,
                 lane_tokens_per_s=lane_tokens / elapsed,
                 decode_steps=steps,
+                **self.quantization_state(),
             )
         if self._slo is not None:
             rec = self._slo.observe(self._iter)
@@ -840,7 +848,27 @@ class GenerationEngine:
             "pool_occupancy_frac": self.pool.occupancy_frac,
             "pool_free_blocks": self.pool.free_blocks,
         }
+        payload["quantization"] = self.quantization_state()
         write_status_json(self._status_path, payload)
+
+    def quantization_state(self) -> Dict[str, Any]:
+        """Active weight/KV storage dtypes + the analytic per-step dequant
+        overhead — what makes a quantized run distinguishable from a bf16
+        run in status_json, serving_window events, and serving_report."""
+        from dalle_pytorch_tpu import quantization as quant_mod
+
+        wk = quant_mod.weight_quant_kind(self.params)
+        kv = self.pool.quant
+        over = quant_mod.dequant_overhead_flops(
+            self.tcfg, kv, wk, self.ecfg.num_slots,
+            emb_rows=self.cfg.total_tokens + self.cfg.num_image_tokens)
+        return {
+            "weight_dtype": wk or str(jnp.dtype(
+                quant_mod.weight_dtype(self.params)).name),
+            "kv_dtype": kv or str(jnp.dtype(self.pool.dtype).name),
+            "dequant_flops_per_step": over["dequant_flops_per_step"],
+            "dequant_frac_of_step": round(over["dequant_frac_of_step"], 6),
+        }
 
     def memory_ledger(self, capacity_bytes: Optional[float] = None):
         """The serving path's HBM ledger: params + the paged pool + the
@@ -854,7 +882,7 @@ class GenerationEngine:
             capacity_bytes=capacity_bytes,
             paged_pool=paged_ledger_entry(
                 self.cfg, self.pool.num_blocks + 1, self.ecfg.block_size,
-                self.ecfg.num_slots,
+                self.ecfg.num_slots, kv_quant=self.pool.quant,
             ),
         )
 

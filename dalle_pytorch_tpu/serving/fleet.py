@@ -80,7 +80,9 @@ class PrefillWorker:
     workers a deployment constructs."""
 
     def __init__(self, params: dict, cfg, filter_thres: float = 0.9,
-                 mesh=None):
+                 mesh=None, quantize_kv: Optional[str] = None):
+        from dalle_pytorch_tpu.quantization import weight_dtype
+
         if mesh is not None:
             from dalle_pytorch_tpu.parallel.reshard import reshard_tree
 
@@ -89,9 +91,9 @@ class PrefillWorker:
         self.cfg = cfg
         self.tcfg = cfg.transformer_config()
         self.filter_thres = filter_thres
+        self.quantize_kv = None if quantize_kv == "none" else quantize_kv
         self.n_pre = cfg.text_seq_len + 1
-        self.itemsize = np.dtype(
-            params["logits_linear"]["w"].dtype).itemsize
+        self.itemsize = np.dtype(weight_dtype(params)).itemsize
         self._fns: Dict[float, Any] = {}
 
     def _fn_for(self, cond_scale: float):
@@ -100,9 +102,21 @@ class PrefillWorker:
         if fn is None:
             cfg, thres = self.cfg, self.filter_thres
 
+            kv_quant = self.quantize_kv
+
             def run(params, text, k0, temperature):
-                return prefill_sample(params, cfg, thres, text, k0,
-                                      temperature, cond_scale)
+                layers, code = prefill_sample(params, cfg, thres, text, k0,
+                                              temperature, cond_scale)
+                if kv_quant:
+                    # compress the handoff ON the prefill mesh: per-token
+                    # scales make quantize-then-ship equal ship-then-quantize,
+                    # so the decode replica's pool is bit-identical either way
+                    from dalle_pytorch_tpu.quantization import (
+                        quantize_cache_layers,
+                    )
+
+                    layers = quantize_cache_layers(layers)
+                return layers, code
 
             fn = jax.jit(run)
             self._fns[key] = fn
@@ -117,7 +131,8 @@ class PrefillWorker:
             ring = (2.0 * self.tcfg.depth * lanes * self.tcfg.image_fmap_size
                     * 2 * (self.tcfg.dim // 4) * self.itemsize)
         return comms_mod.prefill_handoff_row(
-            self.tcfg, self.n_pre, lanes, self.itemsize, ring_bytes=ring)
+            self.tcfg, self.n_pre, lanes, self.itemsize, ring_bytes=ring,
+            kv_quant=self.quantize_kv)
 
     def prefill(self, req: Request) -> Dict[str, Any]:
         """Run prefill + first-token sample for `req` and return the handoff
@@ -157,7 +172,8 @@ class ServingFleet:
         self.prefill_worker: Optional[PrefillWorker] = None
         if fleet_cfg.disaggregate:
             self.prefill_worker = PrefillWorker(
-                params, cfg, filter_thres=fleet_cfg.engine.filter_thres)
+                params, cfg, filter_thres=fleet_cfg.engine.filter_thres,
+                quantize_kv=fleet_cfg.engine.quantize_kv)
             for eng in self.engines:
                 eng.prefill_backend = self.prefill_worker
         self._iter = 0

@@ -49,6 +49,7 @@ class BlockPool:
     num_blocks: int
     block_size: int
     dtype: Any = None
+    quant: Optional[str] = None  # "int8" for a quantized pool, else None
 
     def __post_init__(self):
         assert self.block_size > 0 and self.num_blocks > 0
@@ -65,13 +66,20 @@ class BlockPool:
         import jax.numpy as jnp
 
         dt = dtype if dtype is not None else (self.dtype or jnp.float32)
-        return init_paged_pool(self.cfg, self.num_blocks + 1, self.block_size, dt)
+        return init_paged_pool(self.cfg, self.num_blocks + 1, self.block_size,
+                               dt, quantize=self.quant)
 
     def bytes(self, itemsize: int = 4) -> float:
-        """At-rest bytes of the device pool (k + v, every layer)."""
+        """At-rest bytes of the device pool (k + v, every layer).  On a
+        quantized pool `itemsize` is the dtype the pool WOULD have used —
+        the quantized price (int8 payload + per-token scales) comes from
+        the shared `kv_bytes_per_elem` formula."""
+        from dalle_pytorch_tpu.quantization import kv_bytes_per_elem
+
         return (
             2.0 * self.cfg.depth * (self.num_blocks + 1) * self.cfg.heads
-            * self.block_size * self.cfg.dim_head * itemsize
+            * self.block_size * self.cfg.dim_head
+            * kv_bytes_per_elem(self.quant, itemsize, self.cfg.dim_head)
         )
 
     # -- host free list -----------------------------------------------------
@@ -151,8 +159,23 @@ class BlockPool:
         return list(self._owned)
 
 
+def blocks_within_bytes(cfg: TransformerConfig, budget_bytes: float,
+                        block_size: int, itemsize: int = 2,
+                        kv_quant: Optional[str] = None) -> int:
+    """How many usable blocks fit an at-rest byte budget (trash block's cost
+    included).  The capacity half of the 2x claim: quantizing the pool while
+    holding the BYTE budget fixed roughly doubles the block count, which is
+    what lets admission pass at 2x the slot count."""
+    from dalle_pytorch_tpu.quantization import kv_bytes_per_elem
+
+    per_block = (2.0 * cfg.depth * cfg.heads * block_size * cfg.dim_head
+                 * kv_bytes_per_elem(kv_quant, itemsize, cfg.dim_head))
+    return max(int(budget_bytes // per_block) - 1, 0)  # -1: the trash block
+
+
 def paged_ledger_entry(cfg_geom: Any, num_blocks: int, block_size: int,
                        num_slots: int, itemsize: Optional[int] = None,
+                       kv_quant: Optional[str] = None,
                        ) -> Optional[Dict[str, Any]]:
     """The dict `observability.memory.sampling_memory_ledger` prices its
     paged-pool rows from (geometry comes from the DALLEConfig).  Leave
@@ -166,4 +189,6 @@ def paged_ledger_entry(cfg_geom: Any, num_blocks: int, block_size: int,
     }
     if itemsize is not None:
         entry["itemsize"] = itemsize
+    if kv_quant:
+        entry["kv_quant"] = kv_quant
     return entry

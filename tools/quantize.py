@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Offline checkpoint quantizer: rewrite a checkpoint's matmul weights as
+int8 (or fp8) blocks + per-output-channel scale sidecars, with a
+memory-ledger dry run — `tools/reshard.py`'s UX applied to dtype instead of
+topology.
+
+  * `--dry_run` prints the BEFORE and AFTER per-chip at-rest ledgers (params
+    priced through quantization.tree_weight_bytes, same registry shard
+    fractions as the reshard preflight) plus the measured weight-byte
+    reduction, and exits without writing.
+  * Without `--dry_run`, the quantized tree is written to `--out` (never in
+    place: quantization is lossy, unlike a topology rewrite) with a
+    `meta["quantization"]` stamp, atomically.  Optimizer state (which loads
+    as a TreeBundle) is dropped with a notice: the output is a serving
+    checkpoint, not a resume point.  `--require_reduction X`
+    refuses (exit 2) when the measured reduction lands under X — the
+    mechanical guard for the >=1.9x acceptance bar at realistic geometry.
+
+The quantized tree flows through the v3 checkpoint format unchanged: qvalue
+blocks are numpy-native int8, scales ride the existing dtype sidecar, and
+`quantize_tree` preserves the nested dict paths the partitioning registry
+and `--resume auto` already understand.
+
+Examples:
+
+    # how many bytes would int8 save, and does the result still fit?
+    python tools/quantize.py dalle_step400.npz --dry_run
+
+    # write the quantized serving checkpoint (refuse under 1.9x)
+    python tools/quantize.py dalle_step400.npz --out dalle_int8.npz \\
+        --require_reduction 1.9
+
+Works on npz checkpoints and orbax sharded checkpoint directories (the
+directory form re-saves the quantized state with `save_sharded`)."""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dalle_pytorch_tpu.training import resilience  # noqa: E402
+from dalle_pytorch_tpu.training.checkpoint import (  # noqa: E402
+    TreeBundle,
+    is_sharded_checkpoint,
+    load_checkpoint,
+    load_sharded,
+    save_checkpoint,
+    save_sharded,
+)
+
+
+def _format_ledger(ledger: dict) -> str:
+    lines = []
+    for row in ledger["rows"]:
+        lines.append(f"  {row['name']:<12} {row['bytes'] / 1e9:>8.3f} GB  "
+                     f"({row['detail']})")
+    cap = ledger.get("capacity_bytes")
+    fits = ledger.get("fits")
+    verdict = ("fits" if fits else "DOES NOT FIT" if fits is not None
+               else "capacity unknown — pass --hbm_gb to verdict")
+    lines.append(f"  {'total':<12} {ledger['total_bytes'] / 1e9:>8.3f} GB  "
+                 "per chip at rest (lower bound: no activations)")
+    if cap:
+        lines.append(f"  capacity     {cap / 1e9:>8.3f} GB  -> {verdict}")
+    else:
+        lines.append(f"  -> {verdict}")
+    return "\n".join(lines)
+
+
+def _params_ledger(weights, capacity):
+    from dalle_pytorch_tpu.parallel.reshard import reshard_preflight_ledger
+
+    # single-chip axes, no grad row: an offline serving checkpoint holds no
+    # gradient buffer, and topology is reshard.py's job, not this tool's
+    return reshard_preflight_ledger(
+        weights, None, None, grad_itemsize=None, capacity_bytes=capacity)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("checkpoint", help="npz checkpoint file or orbax "
+                        "sharded checkpoint directory")
+    parser.add_argument("--weights", choices=["int8", "fp8"], default="int8",
+                        help="weight storage dtype (fp8 needs a jax build "
+                             "that ships float8_e4m3fn)")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the before/after memory-ledger verdict "
+                             "and exit without writing")
+    parser.add_argument("--out", type=str, default=None,
+                        help="output path (REQUIRED to write: quantization "
+                             "is lossy, so in-place rewrites are refused)")
+    parser.add_argument("--hbm_gb", type=float, default=None,
+                        help="per-chip HBM capacity in GB for the verdict")
+    parser.add_argument("--require_reduction", type=float, default=None,
+                        help="refuse (exit 2) when the measured weight-byte "
+                             "reduction is under this floor (e.g. 1.9)")
+    parser.add_argument("--allow_legacy_pickle", action="store_true",
+                        help="permit pre-v3 (pickled-treedef) checkpoints — "
+                             "trusted files only")
+    args = parser.parse_args(argv)
+
+    capacity = args.hbm_gb * 1e9 if args.hbm_gb else None
+
+    # validate first: a torn file should say so, not stack-trace
+    try:
+        resilience.validate_checkpoint(args.checkpoint)
+    except resilience.CheckpointInvalidError as e:
+        print(f"INVALID ({type(e).__name__}): {e}")
+        return 1
+
+    from dalle_pytorch_tpu import quantization as quant_mod
+
+    sharded = is_sharded_checkpoint(args.checkpoint)
+    if sharded:
+        trees, meta = load_sharded(args.checkpoint)
+    else:
+        trees, meta = load_checkpoint(
+            args.checkpoint, allow_legacy_pickle=args.allow_legacy_pickle)
+    weights = trees.get("weights")
+    if weights is None:
+        print("REFUSED: checkpoint has no 'weights' tree to quantize")
+        return 1
+    if quant_mod.tree_is_quantized(weights):
+        print("REFUSED: weights are already quantized "
+              f"({quant_mod.weight_quant_kind(weights)}) — quantizing twice "
+              "only re-rounds the scales")
+        return 1
+
+    print(f"checkpoint: {args.checkpoint}")
+    try:
+        quantized = quant_mod.quantize_tree(weights, args.weights)
+    except ValueError as e:
+        print(f"REFUSED: {e}")
+        return 1
+
+    reduction = quant_mod.weight_reduction(weights, quantized)
+    print("per-chip at-rest ledger BEFORE (storage dtypes):")
+    print(_format_ledger(_params_ledger(weights, capacity)))
+    print(f"per-chip at-rest ledger AFTER ({args.weights} matmul blocks):")
+    print(_format_ledger(_params_ledger(quantized, capacity)))
+    print(f"weight-byte reduction vs bf16 storage: {reduction:.3f}x")
+
+    if args.require_reduction is not None and reduction < args.require_reduction:
+        print(f"REFUSED: reduction {reduction:.3f}x is under the required "
+              f"{args.require_reduction}x (scale overhead is eating the "
+              "byte savings — see DESIGN.md round 16 on tiny geometry)")
+        return 2
+
+    if args.dry_run:
+        return 0
+
+    if not args.out:
+        print("REFUSED: --out is required to write (quantization is lossy; "
+              "refusing to clobber the float checkpoint in place)")
+        return 2
+    out = Path(args.out)
+    if out.resolve() == Path(args.checkpoint).resolve():
+        print("REFUSED: --out must differ from the input checkpoint")
+        return 2
+
+    meta = dict(meta or {})
+    meta["quantization"] = {"weights": args.weights}
+    new_trees = dict(trees, weights=quantized)
+    # Optimizer state loads as a TreeBundle (its node types live in optax,
+    # not here) and save_checkpoint would pickle the bundle as one opaque
+    # object leaf — unloadable under allow_pickle=False.  A quantized
+    # serving checkpoint has no use for optimizer moments, so drop them
+    # loudly instead of writing a file load_checkpoint refuses to read.
+    for name in [n for n, t in new_trees.items() if isinstance(t, TreeBundle)]:
+        bundle = new_trees.pop(name)
+        print(f"dropping {name} ({len(bundle.leaves)} leaves): training-only "
+              "state — the quantized output is a serving checkpoint, not a "
+              "resume point")
+    if sharded:
+        save_sharded(str(out), new_trees, meta)
+    else:
+        # save_checkpoint writes tmp + fsync + rename (same durability as
+        # tools/reshard.py's meta rewrite)
+        save_checkpoint(str(out), new_trees, meta)
+    print(f"wrote {out} ({args.weights} weights, "
+          f"{reduction:.3f}x at-rest reduction)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

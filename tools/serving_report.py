@@ -18,6 +18,11 @@ Sections, all from the stream serving/engine.py writes:
   went;
 * **engine windows** (`kind:"serving_window"`) — queue depth, lanes, pool
   occupancy, goodput, and the poll-loop admit/dispatch/block/evict split;
+* **quantization** — when windows carry the engine's quantization state
+  (`--quantize_weights` / `--quantize_kv` runs), the active weight/KV
+  storage dtypes plus the analytic dequant overhead: extra flops per decode
+  step and their fraction of the step's matmul work — per-request overhead
+  is that fraction times the decode share from the phase table;
 * **SLO windows** (`kind:"slo_window"`) + burn-rate / backpressure alarms
   and the refusal/deferral counters from metric snapshots;
 * **fleet** — when request records carry a `replica` tag (serving/fleet.py
@@ -121,6 +126,37 @@ def _fleet_table(reqs: List[Dict[str, Any]],
     return out
 
 
+def _quant_section(windows: List[Dict[str, Any]],
+                   done: List[Dict[str, Any]]) -> List[str]:
+    """Active storage dtypes + dequant overhead, from the quantization state
+    the engine spreads into every serving_window event."""
+    qw = [w for w in windows
+          if w.get("weight_dtype") or w.get("kv_dtype")]
+    if not qw:
+        return []
+    last = qw[-1]
+    out = ["", "quantization:"]
+    out.append(f"  weight storage dtype  {last.get('weight_dtype') or '-'}")
+    out.append(f"  kv storage dtype      {last.get('kv_dtype') or '-'}")
+    frac = last.get("dequant_frac_of_step")
+    flops = last.get("dequant_flops_per_step")
+    if flops is not None:
+        out.append(f"  dequant flops/step    {flops:.3g}")
+    if frac is not None:
+        out.append(f"  dequant frac of step  {frac * 100:.1f}% of matmul work")
+        decode_s = [(r.get("phases") or {}).get("decode") for r in done]
+        decode_s = [v for v in decode_s if v is not None]
+        if decode_s:
+            mean_dec = sum(decode_s) / len(decode_s)
+            out.append(f"  per-request overhead  ~{_ms(mean_dec * frac)} "
+                       f"(dequant frac x mean decode {_ms(mean_dec)})")
+        if frac >= 0.25:
+            out.append("  note: dequant overhead is a large share of the "
+                       "step — at this scale quantization buys capacity "
+                       "(slots/lanes), not wall-clock")
+    return out
+
+
 def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     reqs = [r for r in records
             if r.get("kind") in ("request", "serving_request")]
@@ -186,6 +222,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
                 f"{(w.get('pool_occupancy_frac') or 0) * 100:>7.1f}% "
                 f"{w.get('pool_free_blocks', '-'):>10} "
                 f"{f'{g * 100:.0f}%' if g is not None else '-':>8}  {split}")
+
+    out.extend(_quant_section(windows, done))
 
     if slo_windows:
         out.append("")
