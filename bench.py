@@ -675,6 +675,90 @@ def run_bench() -> dict:
     except Exception as e:  # must never sink the bench
         quantized_parity_row = {"error": str(e)[:200]}
 
+    # serving durability row (ISSUE 14): Poisson load with per-request
+    # deadlines against a 2-replica fleet where one replica is WEDGED
+    # alive-but-stalled from the start (the breaker must open and the
+    # hedger must route around it) and one extra request is persistently
+    # poisoned (NaN decode logits; quarantined after bounded retries).
+    # Completion rate over the organic arrivals, p99 TTFT, and the degrade
+    # rungs entered are the survival numbers `--gate` tracks.
+    serving_durability_row = None
+    try:
+        import numpy as _np
+
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.observability import metrics as _obs_metrics
+        from dalle_pytorch_tpu.serving.degrade import (DegradeConfig,
+                                                       DegradeLadder)
+        from dalle_pytorch_tpu.serving.engine import EngineConfig
+        from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
+
+        PoissonLoadGen, synthetic_request_maker = _import_loadgen()
+
+        dparams = gen_params if on_tpu else state.params
+        d_fleet = ServingFleet(
+            dparams, cfg,
+            fleet_cfg=FleetConfig(
+                replicas=2,
+                engine=EngineConfig(num_slots=2,
+                                    block_size=64 if on_tpu else 16),
+                stall_after_s=0.3, probe_after_s=0.5, hedge_frac=0.25))
+        d_ladder = DegradeLadder(DegradeConfig(), text_seq_len=cfg.text_seq_len)
+        d_fleet.attach_degrade(d_ladder)
+        # counters are process-global: diff around the row
+        def _snap():
+            return {n: _obs_metrics.counter(n).value
+                    for n in ("serving/quarantined", "router/breaker_open",
+                              "router/hedged", "router/hedge_duplicates")}
+        drng = _np.random.RandomState(123)
+        # warm BOTH replicas first (each engine owns its jitted closures):
+        # a cold compile inside the first poll would outlast the wedge and
+        # the breaker would never see a frozen-iteration replica
+        for wseed in (996, 997):
+            d_fleet.submit(
+                drng.randint(1, cfg.num_text_tokens,
+                             size=(cfg.text_seq_len,)),
+                key=jax.random.PRNGKey(wseed), synthetic=True)
+        d_fleet.run_until_idle()
+        before = _snap()
+        # one persistently-poisoned request riding along with the load
+        poison_req = d_fleet.submit(
+            drng.randint(1, cfg.num_text_tokens, size=(cfg.text_seq_len,)),
+            key=jax.random.PRNGKey(999))
+        poison_req.poison_victim = True
+        # a deadline-carrying request placed on the soon-to-stall replica
+        # (synthetic: it must not pollute the organic SLO numbers), then
+        # wedge that replica — busy + not advancing is what trips the
+        # breaker, and the stuck request is what the hedger rescues
+        stuck_req = d_fleet.submit(
+            drng.randint(1, cfg.num_text_tokens, size=(cfg.text_seq_len,)),
+            key=jax.random.PRNGKey(998), synthetic=True, deadline_s=1.0)
+        victim_eng = next(
+            e for e in d_fleet.engines
+            if any(r is stuck_req for r in list(e._inflight) + list(e.queue._q)))
+        victim_eng.wedge(2.0)
+        d_requests = 6
+        d_gen = PoissonLoadGen(d_requests, rate=2.0 if on_tpu else 5.0,
+                               streams=2, seed=0)
+        serving_durability_row = d_gen.run(
+            d_fleet, synthetic_request_maker(cfg, seed=0, deadline_s=2.0),
+            max_wall_s=600 if on_tpu else 300,
+        )
+        d_fleet.run_until_idle()  # flush the poison retries to quarantine
+        delta = {n: _snap()[n] - before[n] for n in before}
+        serving_durability_row["completion_rate"] = round(
+            serving_durability_row["requests_completed"] / d_requests, 4)
+        serving_durability_row["quarantined"] = delta["serving/quarantined"]
+        serving_durability_row["breaker_opens"] = delta["router/breaker_open"]
+        serving_durability_row["hedged"] = delta["router/hedged"]
+        serving_durability_row["hedge_duplicates"] = delta[
+            "router/hedge_duplicates"]
+        serving_durability_row["degrade_rungs_entered"] = dict(
+            d_ladder.rungs_entered)
+        serving_durability_row["degrade_max_rung"] = d_ladder.max_rung_seen
+    except Exception as e:  # must never sink the bench
+        serving_durability_row = {"error": str(e)[:200]}
+
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
     # kept as a secondary row for cross-round continuity.  Each row runs as a
@@ -813,6 +897,7 @@ def run_bench() -> dict:
         "serving_fleet": serving_fleet_row,
         "quantized_serving": quantized_serving_row,
         "quantized_parity": quantized_parity_row,
+        "serving_durability": serving_durability_row,
         "sparse_attention": sparse_attention_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
@@ -894,6 +979,11 @@ GATE_SPECS = {
     # itself via within_budget), and greedy token agreement must hold
     "quantized_parity.greedy_logit_drift_rel": ("lower", 1.0),
     "quantized_parity.token_match_frac": ("higher", 0.05),
+    # durability row runs with one wedged replica + one poisoned request:
+    # completion over the ORGANIC arrivals must stay at/near 1.0 and the
+    # hedged/degraded p99 TTFT bounded — survival is the gated outcome
+    "serving_durability.completion_rate": ("higher", 0.05),
+    "serving_durability.ttft_p99_s": ("lower", 1.0),
     "health_overhead.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),

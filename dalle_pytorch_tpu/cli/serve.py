@@ -33,6 +33,22 @@ a separate worker pool whose KV handoff is priced as a comms-ledger row.
 queued + in-flight requests drain and requeue onto the survivors (the chaos
 `kill-replica` drill asserts zero drops and one `replica_lost` alarm).
 
+Durability (PR 14): `--journal DIR` write-ahead-logs every accepted request
+(fsynced JSONL, serving/journal.py) and REPLAYS the accepted-but-
+unacknowledged ones at startup — after a full-process crash (`--inject_fault
+kill-fleet@ITER`, the chaos `crash-replay` drill) a restart with the same
+`--journal` completes every in-flight request bit-identically (per-request
+RNG streams make replay a plain resubmit).  `--deadline_s`/`--retries`
+attach a budget to loadgen traffic: the fleet router hedges deadline-
+burning requests off stalled replicas (`--inject_fault
+stall-replica@ITER[:IDX]` wedges one alive; the circuit breaker opens,
+probes, and recovers) and bounds requeue hops.  `--degrade` arms the
+load-shed ladder (serving/degrade.py): sustained pressure climbs
+no-CFG -> capped-candidates -> short-prompts-only -> shed, with hysteresis
+both ways.  `--inject_fault poison-request@ITER` flips one in-flight
+request's logits to NaN — the engine quarantines it after bounded retries
+without disturbing cohabiting lanes (the chaos `poison` drill).
+
 Without `--dalle_path` a `--synthetic` random-init model serves (drills and
 smoke tests run without a trained checkpoint)."""
 from __future__ import annotations
@@ -112,6 +128,41 @@ def build_parser():
                      help="atomically rewritten live-status snapshot (live "
                           "percentiles, queue depth, pool occupancy, active "
                           "alarms) at the telemetry-window cadence")
+
+    dur = parser.add_argument_group("durability")
+    dur.add_argument("--journal", type=str, default=None,
+                     help="request-journal directory (append-only fsynced "
+                          "JSONL WAL): accepted requests survive a process "
+                          "crash and are replayed, bit-identically, on the "
+                          "next start with the same directory")
+    dur.add_argument("--deadline_s", type=float, default=None,
+                     help="per-request deadline attached to loadgen traffic; "
+                          "requests past --hedge_frac of it on a stalled "
+                          "replica are hedged onto a survivor")
+    dur.add_argument("--retries", type=int, default=3,
+                     help="requeue/poison-retry budget per request before the "
+                          "terminal requeue_exhausted/poisoned record")
+    dur.add_argument("--degrade", action="store_true",
+                     help="arm the load-shed degradation ladder (no-CFG -> "
+                          "cap-candidates -> short-prompts -> shed)")
+    dur.add_argument("--degrade_enter_s", type=float, default=0.5,
+                     help="sustained pressure before climbing one rung")
+    dur.add_argument("--degrade_exit_s", type=float, default=2.0,
+                     help="sustained calm before descending one rung")
+    dur.add_argument("--stall_wedge_s", type=float, default=3.0,
+                     help="how long the stall-replica fault wedges its "
+                          "victim's poll loop")
+    dur.add_argument("--stall_after_s", type=float, default=1.0,
+                     help="circuit breaker: busy replica making no decode "
+                          "progress for this long -> open")
+    dur.add_argument("--hedge_frac", type=float, default=0.5,
+                     help="hedge a request off a non-closed replica once "
+                          "this fraction of its deadline is burned")
+    dur.add_argument("--requeue_budget_s", type=float, default=30.0,
+                     help="mark_lost: give up requeueing a drained request "
+                          "after this long and shed it (terminal "
+                          "requeue_exhausted record) instead of blocking "
+                          "forever")
 
     traffic = parser.add_argument_group("traffic")
     traffic.add_argument("--prompts", type=str, default=None,
@@ -227,11 +278,40 @@ def main(argv=None):
             fleet_cfg=FleetConfig(
                 replicas=args.replicas, disaggregate=args.disaggregate,
                 engine=engine_cfg,
+                stall_wedge_s=args.stall_wedge_s,
+                stall_after_s=args.stall_after_s,
+                hedge_frac=args.hedge_frac,
+                requeue_budget_s=args.requeue_budget_s,
             ),
         )
     else:
         engine = GenerationEngine(params, dalle_cfg, vae_params, vae_cfg,
                                   engine_cfg=engine_cfg)
+    journal = None
+    if args.journal:
+        from dalle_pytorch_tpu.serving.journal import RequestJournal
+
+        journal = RequestJournal(args.journal)
+        if hasattr(engine, "attach_journal"):
+            engine.attach_journal(journal)
+        else:
+            engine.journal = journal
+    ladder = None
+    if args.degrade:
+        from dalle_pytorch_tpu.serving.degrade import (DegradeConfig,
+                                                       DegradeLadder)
+
+        ladder = DegradeLadder(
+            DegradeConfig(enter_after_s=args.degrade_enter_s,
+                          exit_after_s=args.degrade_exit_s),
+            text_seq_len=dalle_cfg.text_seq_len,
+            on_alarm=(lambda a: tele.alarm(a.pop("type", "degrade_rung"), **a))
+            if tele is not None else None,
+        )
+        if hasattr(engine, "attach_degrade"):
+            engine.attach_degrade(ladder)
+        else:
+            engine.degrade = ladder
     slo_targets = SloTargets(
         ttft_p99_s=args.slo_ttft_p99, latency_p99_s=args.slo_latency_p99,
         images_per_sec_floor=args.slo_images_per_sec,
@@ -257,8 +337,20 @@ def main(argv=None):
     print("[serving] paged-pool ledger:")
     print(memory_mod.format_ledger(ledger))
 
+    replayed = []
     try:
-        report = _run_traffic(args, engine, dalle_cfg, vae_cfg)
+        if journal is not None:
+            replayed = _replay_journal(engine, journal)
+        if args.loadgen or args.prompts or journal is None:
+            report = _run_traffic(args, engine, dalle_cfg, vae_cfg)
+        else:
+            # journal-replay-only restart (the crash-replay drill's second
+            # phase): the journal IS the traffic source
+            report = {
+                "requests_completed": sum(
+                    1 for r in replayed if r.codes is not None),
+                "pool_blocks": engine.pool.num_blocks,
+            }
     except Exception as e:
         if memory_mod.is_oom_error(e):
             path = memory_mod.write_oom_report(
@@ -275,18 +367,58 @@ def main(argv=None):
         if injector is not None:
             injector.uninstall()
         engine.close()  # terminal "deferred" records + final window/status
+        if journal is not None:
+            journal.close()  # queued/in-flight stay unacked -> next replay
         if capture is not None:
             capture.close()
         if tele is not None:
             tele.flush(fleet=False)
             tele.close()
 
+    if journal is not None:
+        report["journal_replayed"] = len(replayed)
+        report["journal_replay_completed"] = sum(
+            1 for r in replayed if r.codes is not None)
+        for k, v in journal.stats().items():
+            report[f"journal_{k}"] = v
+        report["journal_duplicate_acks"] = int(
+            obs_metrics.counter("journal/duplicate_acks").value)
+    if ladder is not None:
+        report["degrade_rung"] = ladder.rung
+        report["degrade_max_rung"] = ladder.max_rung_seen
+        report["degrade_rungs_entered"] = dict(ladder.rungs_entered)
     print("[serving] SLO report:")
     for k, v in report.items():
         print(f"  {k:>26}: {v}")
     if args.report_json:
         Path(args.report_json).write_text(json.dumps(report))
     return report
+
+
+def _replay_journal(engine, journal):
+    """Resubmit every accepted-but-unacknowledged request from the previous
+    process generation and run them to completion BEFORE new traffic starts.
+    Replay is a plain resubmit: a request's whole sample path is a pure
+    function of (text, key, temperature, cond_scale), so greedy replays are
+    bit-identical and stochastic replays re-traverse the exact RNG stream
+    the crashed process was consuming."""
+    payloads = journal.replay()
+    if not payloads:
+        return []
+    print(f"[journal] replaying {len(payloads)} unacknowledged request(s) "
+          f"from {journal.path}")
+    reqs = []
+    for p in payloads:
+        reqs.append(engine.submit_when_able(
+            p["text"], key=p["key"], temperature=p["temperature"],
+            cond_scale=p["cond_scale"], deadline_s=p["deadline_s"],
+            retries_left=(p["retries_left"]
+                          if p["retries_left"] is not None else 3),
+            replayed=True))
+    engine.run_until_idle()
+    done = sum(1 for r in reqs if r.codes is not None)
+    print(f"[journal] replay complete: {done}/{len(reqs)} finished")
+    return reqs
 
 
 def _import_loadgen():
@@ -313,7 +445,8 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
                              seed=args.seed)
         report = gen.run(engine, synthetic_request_maker(
             dalle_cfg, seed=args.seed, temperature=args.temperature,
-            cond_scale=args.cond_scale,
+            cond_scale=args.cond_scale, deadline_s=args.deadline_s,
+            retries=args.retries,
         ))
     else:
         assert args.prompts, "provide --loadgen N or --prompts FILE"
@@ -349,6 +482,9 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
     report["refused_total"] = obs_metrics.counter("serving/refused").value
     report["backpressure_alarms"] = obs_metrics.counter(
         "serving_backpressure_alarms").value
+    report["quarantined"] = obs_metrics.counter("serving/quarantined").value
+    report["poison_retries"] = obs_metrics.counter(
+        "serving/poison_retries").value
     if hasattr(engine, "router"):  # fleet: preemption + disaggregation ledger
         report["replicas"] = len(engine.engines)
         report["replicas_alive"] = len(engine.router.alive())
@@ -356,6 +492,15 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
             "router/replicas_lost").value
         report["requeued_total"] = obs_metrics.counter("router/requeued").value
         report["router_shed"] = obs_metrics.counter("router/shed").value
+        report["breaker_opens"] = obs_metrics.counter(
+            "router/breaker_open").value
+        report["breaker_recoveries"] = obs_metrics.counter(
+            "router/breaker_closed").value
+        report["hedged"] = obs_metrics.counter("router/hedged").value
+        report["hedge_duplicates"] = obs_metrics.counter(
+            "router/hedge_duplicates").value
+        report["requeue_exhausted"] = obs_metrics.counter(
+            "router/requeue_exhausted").value
         if engine.prefill_worker is not None:
             report["handoff_requests"] = obs_metrics.counter(
                 "serving/handoff_requests").value

@@ -23,8 +23,9 @@ not re-page for the breach it was already paged for.
 
 The alarm payload goes to `on_alarm` (wired to the telemetry hub by
 cli/serve.py, where the existing `TraceTrigger` listener turns it into
-a rate-limited profiler capture).  `write_status_json` is the atomic
-scrape surface (tmp + rename) a future multi-replica router reads.
+a rate-limited profiler capture).  `write_status_json` is the durable
+atomic scrape surface (tmp + fsync + rename + directory fsync) a
+multi-replica router reads.
 
 Host-side by construction: this module never imports jax and only does
 dict/float arithmetic — it runs on the engine's poll thread at the
@@ -240,12 +241,27 @@ class SloMonitor:
 
 
 def write_status_json(path: str, payload: Dict[str, Any]) -> None:
-    """Atomic snapshot write: tmp file in the same directory + os.replace,
-    so a concurrent scraper never reads a torn JSON document."""
+    """Durable atomic snapshot write — the save_checkpoint discipline:
+    tmp file in the same directory, fsync the data BEFORE os.replace (an
+    unfsynced rename can surface as an empty file after a power cut: the
+    rename is journaled but the data pages are not), then fsync the
+    directory so the rename itself is durable.  A concurrent scraper never
+    reads a torn JSON document, and a crashed host never leaves a zero-
+    length one."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     tmp = os.path.join(d, f".{os.path.basename(path)}.tmp")
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=2, sort_keys=True, default=str)
         f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)

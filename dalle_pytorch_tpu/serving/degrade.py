@@ -1,0 +1,175 @@
+"""Load-shed degradation ladder: trade declared quality for survival.
+
+Under sustained backpressure (queue fraction) or SLO burn (the PR 11
+monitor's live alarm set), the service steps through explicit rungs instead
+of jumping straight to shedding:
+
+  rung 0  normal         — nothing traded
+  rung 1  no_cfg         — disable classifier-free-guidance lane pairing:
+                           every guided request is shaped to cond_scale 1.0,
+                           HALVING its lane/pool footprint (quality traded:
+                           guidance)
+  rung 2  cap_candidates — new admissions decode with the capped top-k
+                           candidate set (EngineConfig.degraded_filter_thres;
+                           the per-lane `cand_cap` mask in the decode jit)
+                           (quality traded: sampling diversity)
+  rung 3  short_prompts  — admit only prompts with at most
+                           `short_prompt_max` non-pad tokens; long prompts
+                           are refused (kind `degraded_long_prompt`)
+  rung 4  shed           — refuse every new request (kind `degraded_shed`)
+
+Each rung is entered only after `enter_after_s` of SUSTAINED pressure and
+exited only after `exit_after_s` of sustained calm (hysteresis both ways, so
+a noisy queue cannot flap the ladder), publishing the `serving/degrade_rung`
+gauge and one telemetry `degrade_rung` event per transition.  Requests are
+tagged with the rung they were admitted under (`Request.degrade_rung` →
+the terminal record's `degrade_rung` field), so tools/serving_report.py can
+show exactly what quality was traded for survival.
+
+Shaping happens at submit on the engine (`GenerationEngine.submit` calls
+`shape_request`); observation happens once per poll — on the engine for a
+solo deployment, on the fleet (max queue fraction across live replicas) for
+a multi-replica one.  Pure host bookkeeping over values the caller already
+holds; no jax imports (tools/lint_host_sync.py covers this file via the
+serving/ directory target).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused
+
+RUNGS = ("normal", "no_cfg", "cap_candidates", "short_prompts", "shed")
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradeConfig:
+    """Ladder knobs.  Pressure = queue fraction at/above `queue_frac_hi` OR
+    any live SLO burn alarm; calm = queue fraction at/below `queue_frac_lo`
+    AND no burn.  The asymmetric timers are the hysteresis."""
+
+    enter_after_s: float = 0.5   # sustained pressure before climbing a rung
+    exit_after_s: float = 2.0    # sustained calm before descending a rung
+    queue_frac_hi: float = 0.75
+    queue_frac_lo: float = 0.25
+    short_prompt_max: Optional[int] = None  # default: text_seq_len // 2
+    max_rung: int = len(RUNGS) - 1
+
+
+class DegradeLadder:
+    """One ladder instance shared by every engine of a deployment."""
+
+    def __init__(self, cfg: DegradeConfig = DegradeConfig(),
+                 text_seq_len: int = 256, on_alarm=None):
+        self.cfg = cfg
+        self.short_prompt_max = (
+            cfg.short_prompt_max if cfg.short_prompt_max is not None
+            else max(text_seq_len // 2, 1))
+        self.on_alarm = on_alarm
+        self.rung = 0
+        self.max_rung_seen = 0
+        self.rungs_entered: Dict[str, int] = {}
+        self._pressure_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        obs_metrics.gauge("serving/degrade_rung").set(0)
+
+    @property
+    def rung_name(self) -> str:
+        return RUNGS[self.rung]
+
+    # ---------------------------------------------------------- observation
+    @staticmethod
+    def _slo_burning(slo) -> bool:
+        """The PR 11 monitor's live burn state: its episode-alarm set is
+        non-empty while any SLO is burning and empties on recovery."""
+        return bool(getattr(slo, "_alarmed", None))
+
+    def observe(self, queue_frac: float, slo=None,
+                now: Optional[float] = None) -> int:
+        """One pressure sample; returns the (possibly changed) rung.  Called
+        once per poll by whichever layer owns the fleet-wide signal."""
+        now = time.monotonic() if now is None else now
+        burning = self._slo_burning(slo)
+        pressure = queue_frac >= self.cfg.queue_frac_hi or burning
+        calm = queue_frac <= self.cfg.queue_frac_lo and not burning
+        if pressure:
+            self._calm_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            elif (now - self._pressure_since >= self.cfg.enter_after_s
+                    and self.rung < self.cfg.max_rung):
+                self._set_rung(self.rung + 1, queue_frac, burning)
+                self._pressure_since = now  # one rung per sustained window
+        elif calm:
+            self._pressure_since = None
+            if self._calm_since is None:
+                self._calm_since = now
+            elif (now - self._calm_since >= self.cfg.exit_after_s
+                    and self.rung > 0):
+                self._set_rung(self.rung - 1, queue_frac, burning)
+                self._calm_since = now
+        else:
+            # between the thresholds: neither timer accumulates
+            self._pressure_since = None
+            self._calm_since = None
+        return self.rung
+
+    def _set_rung(self, rung: int, queue_frac: float, burning: bool) -> None:
+        prev = self.rung
+        self.rung = rung
+        self.max_rung_seen = max(self.max_rung_seen, rung)
+        if rung > prev:
+            self.rungs_entered[RUNGS[rung]] = (
+                self.rungs_entered.get(RUNGS[rung], 0) + 1)
+            obs_metrics.counter("serving/degrade_climbs").inc()
+        else:
+            obs_metrics.counter("serving/degrade_descents").inc()
+        obs_metrics.gauge("serving/degrade_rung").set(rung)
+        fields = {
+            "rung": rung, "name": RUNGS[rung], "from": prev,
+            "queue_frac": round(queue_frac, 4), "slo_burning": burning,
+        }
+        tele = telemetry.active()
+        if tele is not None:
+            tele.spans.write_event("degrade_rung", **fields)
+        if self.on_alarm is not None and rung > prev:
+            self.on_alarm(dict(fields, type="degrade_rung"))
+
+    # ------------------------------------------------------------- shaping
+    def shape_request(self, req) -> None:
+        """Apply the current rung to a freshly-made Request IN PLACE (the
+        engine calls this before admission screening).  Raises
+        AdmissionRefused at the refusing rungs; tags every request with the
+        rung it was admitted under."""
+        req.degrade_rung = self.rung
+        if self.rung >= 4:
+            raise AdmissionRefused(
+                "degradation ladder at rung shed: refusing all new requests",
+                kind="degraded_shed",
+            )
+        if self.rung >= 3:
+            n_tok = int((np.asarray(req.text) != 0).sum())  # host-sync-ok: host token ids
+            if n_tok > self.short_prompt_max:
+                raise AdmissionRefused(
+                    f"degradation ladder at rung short_prompts: prompt has "
+                    f"{n_tok} tokens > {self.short_prompt_max}",
+                    kind="degraded_long_prompt",
+                )
+        if self.rung >= 1 and req.cond_scale != 1.0:
+            # disable CFG lane-pairing: the request now needs ONE lane
+            req.cond_scale = 1.0
+            obs_metrics.counter("serving/degrade_cfg_disabled").inc()
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "rung": self.rung,
+            "name": self.rung_name,
+            "max_rung_seen": self.max_rung_seen,
+            "rungs_entered": dict(self.rungs_entered),
+        }

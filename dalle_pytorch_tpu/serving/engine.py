@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
+import signal
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional
@@ -81,6 +83,10 @@ class EngineConfig:
     filter_thres: float = 0.9
     telemetry_every: int = 32  # poll iterations between serving_window events
     quantize_kv: Optional[str] = None  # "int8" stores the KV pool quantized
+    poison_max_retries: int = 2  # decode retries before a nonfinite lane is
+    #                              quarantined with a terminal `poisoned` record
+    degraded_filter_thres: float = 0.98  # top-k keep fraction for lanes
+    #                              admitted under the cap-candidates rung
 
 
 class GenerationEngine:
@@ -143,6 +149,14 @@ class GenerationEngine:
             "partner": jnp.arange(S, dtype=jnp.int32),
             "feed_src": jnp.arange(S, dtype=jnp.int32),
             "active": jnp.zeros((S,), bool),
+            # durability lane state: per-lane nonfinite flag (accumulated
+            # jit-pure, pulled only at the eviction sync), the lane the
+            # poison-request fault's victim currently occupies (-1 = none;
+            # tracked across retry hops by _track_poison_lane), and the
+            # per-lane candidate-cap mask the degrade ladder sets at admit
+            "poisoned": jnp.zeros((S,), bool),
+            "poison_lane": jnp.asarray(-1, jnp.int32),
+            "cand_cap": jnp.zeros((S,), bool),
         }
         self._free_lanes: List[int] = list(range(S))
         self._inflight: List[Request] = []
@@ -156,6 +170,19 @@ class GenerationEngine:
         # instead of running prefill in-engine
         self.replica_id: Optional[int] = None
         self.prefill_backend = None
+        # durability hooks: a RequestJournal (serving/journal.py) makes
+        # accepted requests crash-replayable; a DegradeLadder
+        # (serving/degrade.py) shapes/screens submits under pressure
+        # (`degrade_observe` is False when a fleet drives the ladder so the
+        # pressure signal is observed once, fleet-wide, not per engine);
+        # `_stall_until` wedges poll() for the stall-replica fault — alive
+        # but making no progress, the failure mode the circuit breaker trips
+        # on
+        self.journal = None
+        self.degrade = None
+        self.degrade_observe = True
+        self._stall_until = 0.0
+        self._poison_lane_host = -1
         # observability attachments (all optional; telemetry-off poll() runs
         # the identical device schedule with only time.monotonic bookkeeping)
         self._slo = None            # observability.slo.SloMonitor
@@ -208,6 +235,14 @@ class GenerationEngine:
         )
         logits = jnp.where(rows, jnp.finfo(logits.dtype).min, logits)
 
+        # poison-request fault: NaN one lane's raw logits inside the jit.
+        # The injection is a per-lane jnp.where, so every OTHER lane's row is
+        # bit-identical to an uninjected run (the quarantine drill's
+        # cohabitation pin).
+        inject = jnp.arange(S, dtype=jnp.int32) == state["poison_lane"]
+        logits = jnp.where(inject[:, None],
+                           jnp.asarray(jnp.nan, logits.dtype), logits)
+
         # classifier-free guidance across lane pairs (solo lanes pass through)
         null_lg = jnp.take(logits, state["partner"], axis=0)
         lg = jnp.where(
@@ -215,7 +250,29 @@ class GenerationEngine:
             null_lg + (logits - null_lg) * state["cscale"][:, None].astype(logits.dtype),
             logits,
         )
-        filtered = top_k_filter(lg, thres=self.ecfg.filter_thres)
+
+        # jit-pure per-lane nonfinite screen (the resilience.nonfinite_guard
+        # discipline): flag a bad row into state["poisoned"] — the host pulls
+        # the flag ONLY at the existing eviction sync — and sanitize it so
+        # sampling stays defined without touching healthy rows bit-wise.
+        # Post-CFG so a NaN in either lane of a guided pair flags both.
+        bad = ~jnp.isfinite(lg).all(axis=-1) & state["active"]
+        poisoned = state["poisoned"] | bad
+        lg = jnp.where(bad[:, None], jnp.zeros_like(lg), lg)
+
+        # top-k candidate filter with the degrade ladder's per-lane cap: one
+        # lax.top_k (exactly top_k_filter's graph), then capped lanes keep
+        # only the first k_cap sorted columns.  With cand_cap all-False the
+        # kept set — and the scatter — is bit-identical to top_k_filter.
+        V = lg.shape[-1]
+        k = max(int((1.0 - self.ecfg.filter_thres) * V), 1)
+        k_cap = min(max(int((1.0 - self.ecfg.degraded_filter_thres) * V), 1), k)
+        val, ind = jax.lax.top_k(lg, k)
+        keep = jnp.where(state["cand_cap"][:, None],
+                         jnp.arange(k) < k_cap, True)
+        val = jnp.where(keep, val, -jnp.inf)
+        filtered = jnp.put_along_axis(
+            jnp.full_like(lg, -jnp.inf), ind, val, axis=-1, inplace=False)
         keys_t = jnp.take_along_axis(
             state["keys"],
             jnp.clip(state["img_prev"], 0, state["keys"].shape[1] - 1)[:, None, None],
@@ -247,6 +304,7 @@ class GenerationEngine:
             prev_code=jnp.where(act, code, state["prev_code"]),
             img_prev=img_new,
             codes=codes_buf,
+            poisoned=poisoned,
         )
 
     def _prefill_sample_impl(self, params, text, k0, temperature,
@@ -336,7 +394,8 @@ class GenerationEngine:
 
     # ------------------------------------------------------------- lifecycle
     def _make_request(self, text, key, temperature, cond_scale,
-                      synthetic) -> Request:
+                      synthetic, deadline_s=None, retries_left=None,
+                      replayed: bool = False) -> Request:
         if key is None:
             key = jax.random.PRNGKey(self._next_id)
         req = Request(
@@ -346,18 +405,31 @@ class GenerationEngine:
             temperature=float(temperature),  # host-sync-ok: CLI/host scalar
             cond_scale=float(cond_scale),  # host-sync-ok: CLI/host scalar
             synthetic=synthetic,
+            replayed=replayed,
         )
+        if deadline_s is not None:
+            req.deadline_s = float(deadline_s)  # host-sync-ok: CLI/host scalar
+        if retries_left is not None:
+            req.retries_left = int(retries_left)  # host-sync-ok: CLI/host scalar
         self._next_id += 1
         return req
 
     def submit(self, text, key=None, temperature: float = 1.0,
-               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+               cond_scale: float = 1.0, synthetic: bool = False,
+               deadline_s=None, retries_left=None,
+               replayed: bool = False) -> Request:
         """Enqueue one prompt.  `text`: (text_seq_len,) raw token ids;
         `key`: request PRNG key (defaults to PRNGKey(request id)).  Raises
-        AdmissionRefused when the service must shed load (queue full, or
-        the request can never fit the pool)."""
-        req = self._make_request(text, key, temperature, cond_scale, synthetic)
+        AdmissionRefused when the service must shed load (queue full, the
+        request can never fit the pool, or the degrade ladder is screening).
+        An accepted request is journaled (fsynced) before submit returns —
+        the durability point: after this, a crash cannot silently lose it."""
+        req = self._make_request(text, key, temperature, cond_scale,
+                                 synthetic, deadline_s, retries_left,
+                                 replayed)
         try:
+            if self.degrade is not None:
+                self.degrade.shape_request(req)
             self.admission.screen_submit(req)
             self.queue.push(req)
         except AdmissionRefused as e:
@@ -367,19 +439,26 @@ class GenerationEngine:
             self._finish_record(req, "shed", reason=e.reason)
             raise
         obs_metrics.counter("serving/submitted").inc()
+        if self.journal is not None:
+            self.journal.accepted(req)
         return req
 
     def submit_when_able(self, text, key=None, temperature: float = 1.0,
-                         cond_scale: float = 1.0,
-                         synthetic: bool = False) -> Request:
+                         cond_scale: float = 1.0, synthetic: bool = False,
+                         deadline_s=None, retries_left=None,
+                         replayed: bool = False) -> Request:
         """Blocking submit for batch callers (generate.py --engine, the
         prompt-mode serve CLI) and router requeues: a full queue BLOCKS —
         the engine polls until a slot frees — instead of refusing.  Counted
         as ONE `serving/submit_waits`, not a refusal per retry (those
         counters measure shed load, which a waiting batch caller is not).  A
         request that can NEVER fit the pool still refuses outright."""
-        req = self._make_request(text, key, temperature, cond_scale, synthetic)
+        req = self._make_request(text, key, temperature, cond_scale,
+                                 synthetic, deadline_s, retries_left,
+                                 replayed)
         try:
+            if self.degrade is not None:
+                self.degrade.shape_request(req)
             self.admission.screen_submit(req)
         except AdmissionRefused as e:
             obs_metrics.counter("serving/refused").inc()
@@ -394,12 +473,43 @@ class GenerationEngine:
             self.poll()  # a full queue implies busy, so this makes progress
         self.queue.push(req)
         obs_metrics.counter("serving/submitted").inc()
+        if self.journal is not None:
+            self.journal.accepted(req)
         return req
 
     @property
     def busy(self) -> bool:
         """Work pending: queued or in-flight requests."""
         return bool(len(self.queue) or self._inflight)
+
+    def wedge(self, seconds: float) -> None:
+        """Stall-replica fault: make poll() a no-op for `seconds` — the
+        process stays alive and the engine keeps its queue/in-flight state,
+        but its iteration counter and heartbeat stop advancing."""
+        self._stall_until = time.monotonic() + float(seconds)  # host-sync-ok: CLI/host scalar
+        obs_metrics.counter("serving/wedged").inc()
+
+    @property
+    def stalled(self) -> bool:
+        return bool(self._stall_until
+                    and time.monotonic() < self._stall_until)
+
+    def _track_poison_lane(self) -> None:
+        """Pin the poison fault's NaN injection to its victim REQUEST, not a
+        lane index: the victim is re-poisoned on every decode step and every
+        retry hop (its lane changes across re-admissions) until it burns its
+        retry budget and quarantines — a persistently-bad request, the case
+        the quarantine exists for.  Transient nonfinites (no sticky victim)
+        still retry clean and complete."""
+        lane = -1
+        for r in self._inflight:
+            if getattr(r, "poison_victim", False) and r.lanes:
+                lane = r.lanes[0]
+                break
+        if lane != self._poison_lane_host:
+            self._poison_lane_host = lane
+            self._state = dict(self._state,
+                               poison_lane=jnp.asarray(lane, jnp.int32))
 
     @property
     def free_slots(self) -> int:
@@ -435,6 +545,11 @@ class GenerationEngine:
                 "codes": codes,                # accepted prefix (None if queued)
                 "origin_id": req.id,
                 "origin_replica": self.replica_id,
+                # durability budget rides the requeue hop: the router
+                # decrements retries_left and sheds (requeue_exhausted)
+                # when it hits zero
+                "deadline_s": req.deadline_s,
+                "retries_left": req.retries_left,
             }
 
         while True:
@@ -468,6 +583,8 @@ class GenerationEngine:
                 block_tables=st["block_tables"].at[li].set(0),
                 offsets=st["offsets"].at[li].set(0),
                 img_prev=st["img_prev"].at[li].set(0),
+                poisoned=st["poisoned"].at[li].set(False),
+                cand_cap=st["cand_cap"].at[li].set(False),
             )
         obs_metrics.counter("serving/drained").inc(len(exports))
         self._window_event()
@@ -484,15 +601,41 @@ class GenerationEngine:
         the device pull is counted under "block", mirroring the train
         loop's data_wait/dispatch/block) — accumulated per telemetry
         window, all via time.monotonic, no device syncs added."""
+        if self._stall_until:
+            if time.monotonic() < self._stall_until:
+                # wedged (stall-replica fault): alive but making no progress
+                # — no iteration advance, no heartbeat, no decode.  This is
+                # the failure mode the router's circuit breaker must detect
+                # without the replica ever dying.
+                return []
+            self._stall_until = 0.0
         self._iter += 1
         if self._capture is not None:
             self._capture.on_step_start(self._iter)
         self._poll_flood()
+        if (self.replica_id is None
+                and resilience.take_kill_fleet_fault(self._iter)):
+            # single-engine serve: the crash-replay drill dies HERE with no
+            # cleanup (a fleet fires the same fault from fleet.poll first)
+            print(f"[chaos] kill-fleet: SIGKILL whole process at engine "
+                  f"iteration {self._iter}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self.degrade is not None and self.degrade_observe:
+            self.degrade.observe(
+                len(self.queue) / max(self.queue.max_depth, 1),
+                slo=self._slo)
+        if self._inflight and resilience.take_poison_fault(self._iter):
+            victim = self._inflight[0]
+            victim.poison_victim = True
+            print(f"[chaos] poison-request: request {victim.id} poisoned — "
+                  "NaN decode logits until its retry budget burns", flush=True)
+            obs_metrics.counter("serving/poison_injected").inc()
         self._phase = "admit"
         t0 = time.monotonic()
         self._admit_ready()
         t1 = time.monotonic()
         self._phase_acc["admit"] += t1 - t0
+        self._track_poison_lane()
         if self._inflight:
             self._phase = "dispatch"
             self._decode_once()
@@ -588,13 +731,27 @@ class GenerationEngine:
         }
 
     def _finish_record(self, req: Request, outcome: str, **extra) -> None:
-        """The request's single terminal `kind:"request"` record."""
+        """The request's single terminal `kind:"request"` record.  Terminal
+        outcomes acknowledge the journal entry (first ack wins — a hedged
+        copy or a replay racing a pre-crash completion is tagged duplicate
+        and never double-acknowledged)."""
         req.outcome = outcome
+        if (self.journal is not None
+                and outcome in ("completed", "shed", "poisoned",
+                                "requeue_exhausted")):
+            if not self.journal.ack(req, outcome):
+                extra.setdefault("duplicate", True)
         tele = telemetry.active()
         if tele is None:
             return
         if self.replica_id is not None:
             extra.setdefault("replica", self.replica_id)
+        if req.degrade_rung:
+            extra.setdefault("degrade_rung", req.degrade_rung)
+        if req.hedged:
+            extra.setdefault("hedged", True)
+        if req.replayed:
+            extra.setdefault("replayed", True)
         tele.spans.write_event(
             "request", request_id=req.id, outcome=outcome,
             guided=req.guided, synthetic=req.synthetic,
@@ -700,6 +857,7 @@ class GenerationEngine:
             temp=st["temp"].at[lane_idx].set(req.temperature),
             cscale=st["cscale"].at[lane_idx].set(req.cond_scale),
             active=st["active"].at[lane_idx].set(True),
+            cand_cap=st["cand_cap"].at[lane_idx].set(req.degrade_rung >= 2),
         )
         if len(lanes) == 2:
             null = lanes[1]
@@ -743,6 +901,10 @@ class GenerationEngine:
         self._win_lane_tokens += len(self._inflight)
         for req in self._inflight:
             req.codes_done += 1
+            if (self.journal is not None
+                    and req.codes_done % self.journal.progress_every == 0):
+                # host-held counter only — journaling progress adds no sync
+                self.journal.progress(req)
 
     def _evict_finished(self) -> List[Request]:
         done = [r for r in self._inflight if r.codes_done >= self.n_gen]
@@ -750,12 +912,29 @@ class GenerationEngine:
             return done
         t_evict = time.monotonic()
         self._inflight = [r for r in self._inflight if r.codes_done < self.n_gen]
+        # the per-lane nonfinite flags, pulled at the EXISTING eviction sync
+        # (the jit accumulated them; the steady-state decode loop never did)
+        t_flag = time.monotonic()
+        poisoned_flags = np.asarray(self._state["poisoned"])  # host-sync-ok: flag pull at the eviction sync
+        self._phase_acc["block"] += time.monotonic() - t_flag
+        retry: List[Request] = []
+        quarantine: List[Request] = []
+        healthy: List[Request] = []
+        for req in done:
+            if bool(poisoned_flags[req.lanes].any()):
+                if req.poison_retries < self.ecfg.poison_max_retries:
+                    retry.append(req)
+                else:
+                    quarantine.append(req)
+            else:
+                healthy.append(req)
         all_lanes: List[int] = []
         for req in done:
             req.phases["decode"] = t_evict - req.admitted_t
-            t_pull = time.monotonic()
-            req.codes = np.asarray(self._state["codes"][req.lanes[0]])  # host-sync-ok: pulling the finished slot's codes
-            self._phase_acc["block"] += time.monotonic() - t_pull
+            if req in healthy:
+                t_pull = time.monotonic()
+                req.codes = np.asarray(self._state["codes"][req.lanes[0]])  # host-sync-ok: pulling the finished slot's codes
+                self._phase_acc["block"] += time.monotonic() - t_pull
             for i in range(len(req.lanes)):
                 self.pool.free_table((req.id << 1) | i)
             all_lanes.extend(req.lanes)
@@ -769,7 +948,27 @@ class GenerationEngine:
             block_tables=st["block_tables"].at[li].set(0),
             offsets=st["offsets"].at[li].set(0),
             img_prev=st["img_prev"].at[li].set(0),
+            poisoned=st["poisoned"].at[li].set(False),
+            cand_cap=st["cand_cap"].at[li].set(False),
         )
+        for req in retry:
+            # nonfinite lane: evict, free, and re-decode from scratch (same
+            # key, same RNG stream) — a transient NaN won't recur; a truly
+            # poisonous request burns its K retries and quarantines.  Not a
+            # terminal outcome, so no record is written for the retry hop.
+            req.poison_retries += 1
+            req.codes_done = 0
+            req.lanes = None
+            req.admitted_t = None
+            req.codes = None
+            self.queue.requeue(req)
+            obs_metrics.counter("serving/poison_retries").inc()
+        for req in quarantine:
+            obs_metrics.counter("serving/quarantined").inc()
+            self._finish_record(req, "poisoned",
+                                reason="nonfinite decode logits",
+                                retries=req.poison_retries)
+        done = healthy
         for req in done:
             if self._vae_decode is not None:
                 t0 = time.perf_counter()

@@ -34,6 +34,8 @@ stay async inside each replica.
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
 import time
 from typing import Any, Dict, List, Optional
 
@@ -67,6 +69,13 @@ class FleetConfig:
     reshard_on_kill: bool = False
     kill_at_iter: Optional[int] = None
     kill_replica_idx: int = 0
+    # durability knobs: how long a stall-replica fault wedges its victim,
+    # and the router's circuit-breaker / hedging / bounded-requeue budgets
+    stall_wedge_s: float = 3.0
+    stall_after_s: float = 1.0
+    probe_after_s: float = 1.0
+    hedge_frac: float = 0.5
+    requeue_budget_s: float = 30.0
 
 
 class PrefillWorker:
@@ -168,7 +177,12 @@ class ServingFleet:
                              engine_cfg=fleet_cfg.engine, usage_fn=usage_fn)
             for _ in range(fleet_cfg.replicas)
         ]
-        self.router = Router(self.engines, on_alarm=on_alarm)
+        self.router = Router(
+            self.engines, on_alarm=on_alarm,
+            stall_after_s=fleet_cfg.stall_after_s,
+            probe_after_s=fleet_cfg.probe_after_s,
+            hedge_frac=fleet_cfg.hedge_frac,
+            requeue_budget_s=fleet_cfg.requeue_budget_s)
         self.prefill_worker: Optional[PrefillWorker] = None
         if fleet_cfg.disaggregate:
             self.prefill_worker = PrefillWorker(
@@ -178,26 +192,68 @@ class ServingFleet:
                 eng.prefill_backend = self.prefill_worker
         self._iter = 0
         self._killed: List[int] = []
+        self.journal = None
+        self._degrade = None
+
+    # ----------------------------------------------------------- durability
+    def attach_journal(self, journal) -> None:
+        """One shared RequestJournal for the whole fleet: every replica
+        journals accepted/progress/ack against the same WAL, and the router
+        acks its requeue_exhausted sheds there too."""
+        self.journal = journal
+        self.router.journal = journal
+        for eng in self.engines:
+            eng.journal = journal
+
+    def attach_degrade(self, ladder) -> None:
+        """One shared DegradeLadder: every replica shapes/screens submits
+        with it, but only the FLEET observes pressure (max queue fraction
+        across live replicas), so the rung timers see one signal."""
+        self._degrade = ladder
+        for eng in self.engines:
+            eng.degrade = ladder
+            eng.degrade_observe = False
 
     # ------------------------------------------------------ engine surface
     def submit(self, text, key=None, temperature: float = 1.0,
-               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+               cond_scale: float = 1.0, synthetic: bool = False,
+               deadline_s=None, retries_left=None,
+               replayed: bool = False) -> Request:
         return self.router.submit(text, key=key, temperature=temperature,
-                                  cond_scale=cond_scale, synthetic=synthetic)
+                                  cond_scale=cond_scale, synthetic=synthetic,
+                                  deadline_s=deadline_s,
+                                  retries_left=retries_left,
+                                  replayed=replayed)
 
     def submit_when_able(self, text, key=None, temperature: float = 1.0,
-                         cond_scale: float = 1.0) -> Request:
+                         cond_scale: float = 1.0, deadline_s=None,
+                         retries_left=None, replayed: bool = False) -> Request:
         return self.router.submit_when_able(
-            text, key=key, temperature=temperature, cond_scale=cond_scale)
+            text, key=key, temperature=temperature, cond_scale=cond_scale,
+            deadline_s=deadline_s, retries_left=retries_left,
+            replayed=replayed)
 
     @property
     def busy(self) -> bool:
         return self.router.busy
 
     def poll(self) -> List[Request]:
-        """One fleet iteration: arm/fire the kill-replica drill, poll every
+        """One fleet iteration: arm/fire the chaos drills (kill-replica,
+        kill-fleet, stall-replica), observe the degrade ladder, poll every
         live replica, refresh the fleet gauges."""
         self._iter += 1
+        if resilience.take_kill_fleet_fault(self._iter):
+            # the crash-replay drill: die with NO cleanup — no drain, no
+            # terminal records, no journal acks.  Only the WAL survives.
+            print(f"[chaos] kill-fleet: SIGKILL whole process at fleet "
+                  f"iteration {self._iter}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        sidx = resilience.take_stall_replica_fault(self._iter)
+        if sidx is not None and int(sidx) < len(self.engines):  # host-sync-ok: parsed CLI number
+            print(f"[chaos] stall-replica: wedging replica {int(sidx)} for "  # host-sync-ok: parsed CLI number
+                  f"{self.fcfg.stall_wedge_s}s at fleet iteration "
+                  f"{self._iter}", flush=True)
+            self.engines[int(sidx)].wedge(self.fcfg.stall_wedge_s)  # host-sync-ok: parsed CLI number
         idx = resilience.take_kill_replica_fault(self._iter)
         if (idx is None and self.fcfg.kill_at_iter is not None
                 and self._iter >= self.fcfg.kill_at_iter
@@ -205,6 +261,11 @@ class ServingFleet:
             idx = self.fcfg.kill_replica_idx
         if idx is not None:
             self.kill_replica(int(idx))  # host-sync-ok: parsed CLI number
+        if self._degrade is not None:
+            live = self.router.alive()
+            frac = max((len(r.engine.queue) / max(r.engine.queue.max_depth, 1)
+                        for r in live), default=0.0)
+            self._degrade.observe(frac, slo=self.engines[0]._slo)
         done = self.router.poll()
         self.router.publish_gauges()
         return done

@@ -1,0 +1,244 @@
+"""Append-only request journal: the durability layer under the serving stack.
+
+A fsynced JSONL write-ahead log so a full-process crash (kill-fleet fault,
+OOM-kill, power loss) cannot silently lose an *accepted* request.  Three
+record kinds:
+
+  ``accepted``  — the full replayable payload (text token ids, raw PRNG key
+                  words, temperature, cond_scale, deadline/retry budget),
+                  fsynced BEFORE the submit returns to the client.  This is
+                  the per-admit durability cost DESIGN.md round 17 prices.
+  ``progress``  — every `progress_every` decode steps: ``codes_done``, which
+                  is simultaneously the accepted-codes prefix length and the
+                  request's RNG stream position (the engine burns exactly one
+                  per-lane key per generated code — the same state `drain()`
+                  exports for requeue).  Host-held counter only: recording
+                  progress never forces a device sync.
+  ``ack``       — terminal outcome (completed / shed / poisoned /
+                  requeue_exhausted).  First ack wins; duplicate acks (a
+                  hedged copy finishing second, a replayed request racing a
+                  pre-crash completion) are suppressed and counted.
+
+Replay (`RequestJournal.replay()`) returns every accepted-but-unacknowledged
+payload in accept order.  Because a request's whole sample path is a pure
+function of (text, key, temperature, cond_scale) — per-request RNG streams,
+PR 7 — replay simply resubmits: greedy replays are bit-identical and
+stochastic replays regenerate the exact RNG stream the crashed process was
+consuming, without the journal ever holding device state.
+
+Requests are keyed by a content uid (sha1 of key words + text ids + sampler
+knobs) rather than engine-local ids, so the same logical request keeps one
+journal identity across requeue hops, hedged duplicates, and process
+restarts.
+
+Host-side file I/O only — no jax imports.  tools/lint_host_sync.py covers
+this file via the serving/ directory target; the deliberate host pulls are
+waived inline.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+
+JOURNAL_NAME = "journal.jsonl"
+
+# terminal outcomes that acknowledge (retire) a journaled request; "deferred"
+# is deliberately absent — a request still queued/in-flight at close() stays
+# unacknowledged so the next process replays it.
+ACK_OUTCOMES = ("completed", "shed", "poisoned", "requeue_exhausted")
+
+
+def request_uid(text, key, temperature: float = 1.0,
+                cond_scale: float = 1.0) -> str:
+    """Stable content id for one logical request: the sha1 of everything
+    that determines its sample path.  Identical across processes, requeue
+    hops, and hedged duplicates (which share the payload by construction)."""
+    text_ids = np.asarray(text).ravel().tolist()  # host-sync-ok: host token ids
+    key_words = np.asarray(key).ravel().tolist()  # host-sync-ok: raw key words
+    blob = json.dumps(
+        [key_words, text_ids, round(float(temperature), 8),  # host-sync-ok: host scalar
+         round(float(cond_scale), 8)],  # host-sync-ok: host scalar
+        separators=(",", ":"),
+    ).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so a freshly-created journal file
+    survives the crash that motivated journaling in the first place."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class RequestJournal:
+    """Append-only fsynced JSONL WAL over one directory.
+
+    Opening an existing journal (the restart path) first scans it so ack
+    dedup and `replay()` see pre-crash history; appends then continue the
+    same file — the journal is the union of every process generation's
+    records, and replay tolerates a torn final line (crash mid-append)."""
+
+    def __init__(self, dir_path: str, progress_every: int = 8):
+        self.dir = dir_path
+        self.path = os.path.join(dir_path, JOURNAL_NAME)
+        self.progress_every = max(int(progress_every), 1)  # host-sync-ok: host config scalar
+        os.makedirs(dir_path, exist_ok=True)
+        self._accepted: Dict[str, Dict[str, Any]] = {}
+        self._progress: Dict[str, int] = {}
+        self._acked: Dict[str, str] = {}
+        self._order: List[str] = []
+        for rec in self._scan():
+            self._absorb(rec)
+        self._f = open(self.path, "a", encoding="utf-8")
+        _fsync_dir(self.path)
+
+    # ------------------------------------------------------------- scanning
+    def _scan(self) -> List[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return []
+        out: List[Dict[str, Any]] = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # torn final line from a crash mid-append: the record it
+                    # would have been was not durable, so it never happened
+                    obs_metrics.counter("journal/torn_records").inc()
+                    break
+        return out
+
+    def _absorb(self, rec: Dict[str, Any]) -> None:
+        kind = rec.get("kind")
+        uid = rec.get("uid")
+        if not uid:
+            return
+        if kind == "accepted":
+            if uid not in self._accepted:
+                self._order.append(uid)
+            self._accepted[uid] = rec
+        elif kind == "progress":
+            self._progress[uid] = max(
+                self._progress.get(uid, 0), int(rec.get("codes_done", 0)))
+        elif kind == "ack":
+            self._acked.setdefault(uid, rec.get("outcome", "completed"))
+
+    # ------------------------------------------------------------- appends
+    def _append(self, rec: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def accepted(self, req) -> str:
+        """Journal one accepted request (fsynced before returning — the
+        admit-side durability point).  Stamps `req.journal_uid`.  Re-accepting
+        a known uid (a replayed or requeued request) appends nothing new."""
+        uid = getattr(req, "journal_uid", None) or request_uid(
+            req.text, req.key, req.temperature, req.cond_scale)
+        req.journal_uid = uid
+        if uid in self._acked or uid in self._accepted:
+            return uid
+        rec = {
+            "kind": "accepted",
+            "uid": uid,
+            "t": time.time(),
+            "text": np.asarray(req.text).ravel().tolist(),  # host-sync-ok: host token ids
+            "key": np.asarray(req.key).ravel().tolist(),  # host-sync-ok: raw key words
+            "temperature": float(req.temperature),  # host-sync-ok: host scalar
+            "cond_scale": float(req.cond_scale),  # host-sync-ok: host scalar
+            "synthetic": bool(req.synthetic),
+            "deadline_s": getattr(req, "deadline_s", None),
+            "retries_left": getattr(req, "retries_left", None),
+        }
+        self._absorb(rec)
+        self._append(rec)
+        obs_metrics.counter("journal/accepted").inc()
+        return uid
+
+    def progress(self, req) -> None:
+        """Record the codes-done prefix length == RNG stream position.  The
+        engine calls this every `progress_every` decode steps with its own
+        host-held counter — no device sync."""
+        uid = getattr(req, "journal_uid", None)
+        if uid is None or uid in self._acked:
+            return
+        done = int(req.codes_done)  # host-sync-ok: host-held decode counter
+        if done <= self._progress.get(uid, 0):
+            return
+        self._progress[uid] = done
+        self._append({"kind": "progress", "uid": uid, "codes_done": done,
+                      "rng_pos": done})
+
+    def ack(self, req, outcome: str) -> bool:
+        """Acknowledge a terminal outcome.  Returns True when this is the
+        FIRST ack for the uid; a duplicate (hedged copy finishing second,
+        replay racing a pre-crash completion) is suppressed and counted."""
+        uid = getattr(req, "journal_uid", None)
+        if uid is None:
+            return True  # never journaled (journal attached mid-flight)
+        if uid in self._acked:
+            obs_metrics.counter("journal/duplicate_acks").inc()
+            return False
+        self._acked[uid] = outcome
+        self._append({"kind": "ack", "uid": uid, "outcome": outcome,
+                      "t": time.time()})
+        obs_metrics.counter(f"journal/ack_{outcome}").inc()
+        return True
+
+    # --------------------------------------------------------------- replay
+    def unacknowledged(self) -> List[str]:
+        return [u for u in self._order if u not in self._acked]
+
+    def replay(self) -> List[Dict[str, Any]]:
+        """Every accepted-but-unacknowledged payload, in accept order, ready
+        to resubmit: text/key as arrays plus the sampler knobs and the
+        deadline/retry budget the request was accepted with.  `codes_done`
+        reports how far the crashed process had decoded (the RNG stream
+        position it will deterministically re-traverse)."""
+        out: List[Dict[str, Any]] = []
+        for uid in self.unacknowledged():
+            rec = self._accepted[uid]
+            out.append({
+                "uid": uid,
+                "text": np.asarray(rec["text"], dtype=np.int32),  # host-sync-ok: journal record
+                "key": np.asarray(rec["key"], dtype=np.uint32),  # host-sync-ok: journal record
+                "temperature": float(rec.get("temperature", 1.0)),
+                "cond_scale": float(rec.get("cond_scale", 1.0)),
+                "synthetic": bool(rec.get("synthetic", False)),
+                "deadline_s": rec.get("deadline_s"),
+                "retries_left": rec.get("retries_left"),
+                "codes_done": self._progress.get(uid, 0),
+            })
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "accepted": len(self._accepted),
+            "acked": len(self._acked),
+            "unacknowledged": len(self.unacknowledged()),
+        }
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except (OSError, ValueError):
+            pass
+        self._f.close()

@@ -1,4 +1,5 @@
-"""Multi-replica request router: load-balanced admission + requeue-on-loss.
+"""Multi-replica request router: load-balanced admission, a circuit breaker
+per replica, deadline hedging, and bounded requeue-on-loss.
 
 Pure host logic over N engine replicas (serving/fleet.py builds them; any
 object with the GenerationEngine surface works).  Placement reads each
@@ -10,12 +11,28 @@ only picks the order to try, and when EVERY live replica refuses, that
 becomes a router-level shed (`router/shed` counter, the per-kind refusal
 counters fire on the replicas).
 
+Circuit breaker: a replica whose iteration counter stops advancing while it
+has work (the stall-replica fault wedges one — alive, not dead) trips
+closed→open after `stall_after_s` with ONE `replica_circuit_open` alarm per
+episode (PR 4 discipline: re-armed when the breaker closes).  Open replicas
+take no new placements; after `probe_after_s` the breaker half-opens and
+the replica rejoins the ranking at a penalty, so the next placement that
+lands there is a probe (`router/breaker_probes`).  Progress — the iteration
+counter advancing again — closes the breaker.
+
+Hedging: a request with a deadline sitting on a stalled (open/half-open)
+replica past `hedge_frac` of its budget is re-placed on a survivor with the
+SAME key/text (per-request RNG streams make the copy's output identical).
+First completion wins; the loser is suppressed at the router
+(`router/hedge_duplicates`) and never double-acknowledged in the journal.
+
 Serve-through-preemption: `mark_lost(i)` drains the dead replica
 (engine.drain() exports per-slot state: prompt, accepted codes, RNG stream
 position), emits ONE `replica_lost` alarm through the telemetry hub, and
-requeues every export onto the survivors with BLOCKING submits — a request
-the fleet accepted is never silently dropped; per-request RNG streams make
-the survivor's re-decode bit-identical.
+requeues every export onto the survivors under a BOUNDED backoff budget —
+when `requeue_budget_s` elapses (or the export's retry budget is spent) the
+request is shed with a terminal `requeue_exhausted` record and an alarm
+instead of hanging the router thread on saturated survivors.
 
 Everything here is time.monotonic/free-list bookkeeping on host values the
 engines already hold — no device syncs (tools/lint_host_sync.py covers this
@@ -24,10 +41,12 @@ file via the serving/ directory target).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional
 
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.serving.journal import request_uid
 from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused, Request
 
 
@@ -40,16 +59,40 @@ class Replica:
     alive: bool = True
 
 
+class _JournalStub:
+    """Just enough request surface for `RequestJournal.ack` when the router
+    sheds a drained EXPORT (a dict, not a live Request)."""
+
+    def __init__(self, uid: str):
+        self.journal_uid = uid
+
+
 class Router:
     """Fronts N engine replicas; balances on live load, sheds when all
     refuse, requeues a lost replica's work onto survivors."""
 
-    def __init__(self, engines: List[Any], on_alarm=None):
+    def __init__(self, engines: List[Any], on_alarm=None, *,
+                 stall_after_s: float = 1.0, probe_after_s: float = 1.0,
+                 hedge_frac: float = 0.5, requeue_budget_s: float = 30.0):
         assert engines, "a router needs at least one replica"
         self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
         for r in self.replicas:
             r.engine.replica_id = r.id
         self.on_alarm = on_alarm
+        self.journal = None  # shared RequestJournal (cli/serve.py --journal)
+        self.stall_after_s = stall_after_s
+        self.probe_after_s = probe_after_s
+        self.hedge_frac = hedge_frac
+        self.requeue_budget_s = requeue_budget_s
+        now = time.monotonic()
+        self._breaker: Dict[int, Dict[str, Any]] = {
+            r.id: {"state": "closed", "last_iter": r.engine._iter,
+                   "last_progress_t": now, "opened_t": 0.0}
+            for r in self.replicas
+        }
+        self._breaker_alarmed: set = set()
+        self._hedged: set = set()       # uids with a live hedge copy
+        self._hedge_done: set = set()   # uids already delivered once
         obs_metrics.gauge("fleet_serving/replicas_alive").set(
             len(self.replicas))
 
@@ -91,51 +134,66 @@ class Router:
             + 0.5 * (load["hbm_usage"] or 0.0)
         )
 
-    def ranked(self) -> List[Replica]:
-        live = self.alive()
-        return sorted(live, key=lambda r: self.score(self.replica_load(r)))
+    def breaker_state(self, rid: int) -> str:
+        return self._breaker[rid]["state"]
+
+    def ranked(self, exclude: Optional[int] = None) -> List[Replica]:
+        """Live replicas by placement preference.  Breaker-open replicas are
+        OUT of the ranking entirely; half-open ones rejoin at a flat score
+        penalty, so a placement only lands there when the healthy replicas
+        are worse/refusing — that placement is the breaker's probe."""
+        out = []
+        for r in self.alive():
+            if r.id == exclude:
+                continue
+            state = self._breaker[r.id]["state"]
+            if state == "open":
+                continue
+            penalty = 5.0 if state == "half_open" else 0.0
+            out.append((self.score(self.replica_load(r)) + penalty, r))
+        return [r for _, r in sorted(out, key=lambda t: t[0])]
 
     # ----------------------------------------------------------- admission
+    def _place(self, blocking: bool, text, key, temperature, cond_scale,
+               synthetic, deadline_s, retries_left, replayed,
+               exclude: Optional[int] = None) -> Request:
+        last: Optional[AdmissionRefused] = None
+        for r in self.ranked(exclude=exclude):
+            fn = (r.engine.submit_when_able if blocking else r.engine.submit)
+            try:
+                req = fn(text, key=key, temperature=temperature,
+                         cond_scale=cond_scale, synthetic=synthetic,
+                         deadline_s=deadline_s, retries_left=retries_left,
+                         replayed=replayed)
+                obs_metrics.counter(f"router/submitted_r{r.id}").inc()
+                if self._breaker[r.id]["state"] == "half_open":
+                    obs_metrics.counter("router/breaker_probes").inc()
+                return req
+            except AdmissionRefused as e:
+                last = e
+        obs_metrics.counter("router/shed").inc()
+        if last is not None:
+            raise last
+        raise AdmissionRefused("no live replicas", kind="fleet_saturated")
+
     def submit(self, text, key=None, temperature: float = 1.0,
-               cond_scale: float = 1.0, synthetic: bool = False) -> Request:
+               cond_scale: float = 1.0, synthetic: bool = False,
+               deadline_s=None, retries_left=None,
+               replayed: bool = False) -> Request:
         """Place one request on the best-scored live replica; fall through
         the ranking on refusal.  All replicas refusing is a ROUTER-level
         shed (counted), re-raised so callers see one AdmissionRefused."""
-        last: Optional[AdmissionRefused] = None
-        for r in self.ranked():
-            try:
-                req = r.engine.submit(
-                    text, key=key, temperature=temperature,
-                    cond_scale=cond_scale, synthetic=synthetic)
-                obs_metrics.counter(f"router/submitted_r{r.id}").inc()
-                return req
-            except AdmissionRefused as e:
-                last = e
-        obs_metrics.counter("router/shed").inc()
-        if last is not None:
-            raise last
-        raise AdmissionRefused("no live replicas", kind="fleet_saturated")
+        return self._place(False, text, key, temperature, cond_scale,
+                           synthetic, deadline_s, retries_left, replayed)
 
     def submit_when_able(self, text, key=None, temperature: float = 1.0,
-                         cond_scale: float = 1.0,
-                         synthetic: bool = False) -> Request:
-        """Blocking placement (batch callers, requeues): the best-scored
-        replica that could EVER serve the request waits for room instead of
-        refusing."""
-        last: Optional[AdmissionRefused] = None
-        for r in self.ranked():
-            try:
-                req = r.engine.submit_when_able(
-                    text, key=key, temperature=temperature,
-                    cond_scale=cond_scale, synthetic=synthetic)
-                obs_metrics.counter(f"router/submitted_r{r.id}").inc()
-                return req
-            except AdmissionRefused as e:
-                last = e
-        obs_metrics.counter("router/shed").inc()
-        if last is not None:
-            raise last
-        raise AdmissionRefused("no live replicas", kind="fleet_saturated")
+                         cond_scale: float = 1.0, synthetic: bool = False,
+                         deadline_s=None, retries_left=None,
+                         replayed: bool = False) -> Request:
+        """Blocking placement (batch callers): the best-scored replica that
+        could EVER serve the request waits for room instead of refusing."""
+        return self._place(True, text, key, temperature, cond_scale,
+                           synthetic, deadline_s, retries_left, replayed)
 
     # ------------------------------------------------------------- serving
     @property
@@ -146,7 +204,96 @@ class Router:
         done: List[Request] = []
         for r in self.alive():
             done.extend(r.engine.poll())
-        return done
+        self._update_breakers()
+        self._hedge_stalled()
+        return self._dedup_completions(done)
+
+    # ------------------------------------------------------ circuit breaker
+    def _update_breakers(self) -> None:
+        """Closed→open when a replica's iteration counter sits still for
+        `stall_after_s` while it has work (a wedged engine's poll() is a
+        no-op, so the counter — and its heartbeat — freeze); open→half_open
+        after `probe_after_s`; any progress closes the breaker and re-arms
+        the episode alarm."""
+        now = time.monotonic()
+        for r in self.alive():
+            b = self._breaker[r.id]
+            it = r.engine._iter
+            if it != b["last_iter"] or not r.engine.busy:
+                b["last_iter"] = it
+                b["last_progress_t"] = now
+                if b["state"] != "closed":
+                    b["state"] = "closed"
+                    obs_metrics.counter("router/breaker_closed").inc()
+                    self._breaker_alarmed.discard(r.id)  # re-arm the episode
+                continue
+            if (b["state"] == "closed"
+                    and now - b["last_progress_t"] >= self.stall_after_s):
+                b["state"] = "open"
+                b["opened_t"] = now
+                obs_metrics.counter("router/breaker_open").inc()
+                if r.id not in self._breaker_alarmed:
+                    self._breaker_alarmed.add(r.id)
+                    self._alarm({
+                        "type": "replica_circuit_open", "replica": r.id,
+                        "stalled_s": round(now - b["last_progress_t"], 3),
+                        "inflight": len(r.engine._inflight),
+                        "queued": len(r.engine.queue),
+                    })
+            elif (b["state"] == "open"
+                    and now - b["opened_t"] >= self.probe_after_s):
+                b["state"] = "half_open"
+                obs_metrics.counter("router/breaker_half_open").inc()
+
+    # -------------------------------------------------------------- hedging
+    def _hedge_stalled(self) -> None:
+        """Re-place a deadline-carrying request stuck on a breaker-open/
+        half-open replica once it has burned `hedge_frac` of its budget.
+        The copy shares text/key/knobs, so its output — and its journal
+        uid — are identical; whichever finishes first wins."""
+        now = time.monotonic()
+        for r in self.alive():
+            if self._breaker[r.id]["state"] == "closed":
+                continue
+            stuck = list(r.engine._inflight) + list(r.engine.queue._q)
+            for req in stuck:
+                frac = req.deadline_frac(now)
+                if frac is None or frac < self.hedge_frac or req.hedged:
+                    continue
+                uid = req.journal_uid or request_uid(
+                    req.text, req.key, req.temperature, req.cond_scale)
+                try:
+                    copy = self._place(
+                        False, req.text, req.key, req.temperature,
+                        req.cond_scale, req.synthetic, req.deadline_s,
+                        req.retries_left, False, exclude=r.id)
+                except AdmissionRefused:
+                    continue  # survivors saturated — retry next poll
+                req.hedged = True
+                copy.hedged = True
+                copy.hedge_uid = uid
+                req.hedge_uid = uid
+                self._hedged.add(uid)
+                obs_metrics.counter("router/hedged").inc()
+
+    def _dedup_completions(self, done: List[Request]) -> List[Request]:
+        """First-completion-wins: the second copy of a hedged pair (the
+        original limping in after the stall clears, or the hedge losing the
+        race) is suppressed and counted, never delivered twice."""
+        if not self._hedged:
+            return done
+        out: List[Request] = []
+        for req in done:
+            uid = req.hedge_uid or req.journal_uid
+            if uid is None or uid not in self._hedged:
+                out.append(req)
+                continue
+            if uid in self._hedge_done:
+                obs_metrics.counter("router/hedge_duplicates").inc()
+                continue
+            self._hedge_done.add(uid)
+            out.append(req)
+        return out
 
     def publish_gauges(self) -> None:
         for r in self.alive():
@@ -162,7 +309,11 @@ class Router:
     def mark_lost(self, idx: int, reason: str = "killed") -> List[Request]:
         """A replica died: drain its queued + in-flight requests, alarm
         `replica_lost` ONCE through the hub, and requeue every export onto
-        the survivors (blocking — an accepted request is never dropped).
+        the survivors under a BOUNDED backoff budget.  The old blocking
+        submits could spin indefinitely against saturated survivors; now a
+        requeue that cannot place within `requeue_budget_s` (or whose retry
+        budget is spent) is shed with a terminal `requeue_exhausted` record
+        — journaled, counted, and alarmed — instead of hanging the router.
         Returns the requeued Request objects on their new replicas."""
         r = self.replicas[idx]
         if not r.alive:
@@ -177,15 +328,67 @@ class Router:
             "requeued": len(exports), "survivors": len(survivors),
         })
         requeued: List[Request] = []
+        exhausted = 0
+        deadline = time.monotonic() + self.requeue_budget_s
         for exp in exports:
-            requeued.append(self.submit_when_able(
-                exp["text"], key=exp["key"],
-                temperature=exp["temperature"],
-                cond_scale=exp["cond_scale"],
-                synthetic=exp["synthetic"],
-            ))
-            obs_metrics.counter("router/requeued").inc()
+            retries = exp.get("retries_left")
+            if retries is not None and retries <= 0:
+                self._shed_export(exp, "retry budget spent")
+                exhausted += 1
+                continue
+            placed = None
+            while placed is None:
+                try:
+                    placed = self.submit(
+                        exp["text"], key=exp["key"],
+                        temperature=exp["temperature"],
+                        cond_scale=exp["cond_scale"],
+                        synthetic=exp["synthetic"],
+                        deadline_s=exp.get("deadline_s"),
+                        retries_left=None if retries is None else retries - 1,
+                    )
+                except AdmissionRefused:
+                    if time.monotonic() >= deadline:
+                        self._shed_export(
+                            exp, f"no survivor admitted within "
+                                 f"{self.requeue_budget_s:.1f}s")
+                        exhausted += 1
+                        break
+                    # drain the survivors a little, then retry — bounded
+                    # backoff, not a blocking submit
+                    self.poll()
+                    time.sleep(0.005)
+            if placed is not None:
+                requeued.append(placed)
+                obs_metrics.counter("router/requeued").inc()
+        if exhausted:
+            self._alarm({
+                "type": "requeue_exhausted", "replica": idx,
+                "shed": exhausted, "requeued": len(requeued),
+                "budget_s": self.requeue_budget_s,
+            })
         return requeued
+
+    def _shed_export(self, exp: Dict[str, Any], why: str) -> None:
+        """Terminal accounting for a drained request the fleet could NOT
+        re-place: one `requeue_exhausted` request record, the counter, and
+        the journal ack (so a restart does not replay a request the router
+        deliberately shed)."""
+        obs_metrics.counter("router/requeue_exhausted").inc()
+        uid = request_uid(exp["text"], exp["key"], exp["temperature"],
+                          exp["cond_scale"])
+        if self.journal is not None:
+            self.journal.ack(_JournalStub(uid), "requeue_exhausted")
+        tele = telemetry.active()
+        if tele is not None:
+            tele.spans.write_event(
+                "request", request_id=exp.get("origin_id"),
+                outcome="requeue_exhausted", reason=why,
+                synthetic=exp.get("synthetic", False),
+                guided=exp.get("cond_scale", 1.0) != 1.0,
+                decode_tokens=exp.get("codes_done", 0),
+                replica=exp.get("origin_replica"),
+            )
 
     def _alarm(self, fields: Dict[str, Any]) -> None:
         if self.on_alarm is not None:

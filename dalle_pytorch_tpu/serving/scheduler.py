@@ -67,6 +67,14 @@ class Request:
     temperature: float = 1.0
     cond_scale: float = 1.0
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
+    # durability budget (router/journal-owned): `deadline_s` is seconds from
+    # arrival before the request is hedge-eligible/late (None = no deadline);
+    # `retries_left` bounds how many requeue/poison-retry hops remain before
+    # the terminal requeue_exhausted / poisoned record.  Both are carried
+    # through drain() exports and journal `accepted` records so the budget
+    # survives requeue hops and process crashes.
+    deadline_s: Optional[float] = None
+    retries_left: int = 3
     # runtime (engine-owned)
     lanes: Optional[List[int]] = None
     codes_done: int = 0
@@ -74,6 +82,15 @@ class Request:
     ttft_s: Optional[float] = None
     latency_s: Optional[float] = None
     synthetic: bool = False
+    # durability trace: journal content-uid, poison retry count, hedge links
+    journal_uid: Optional[str] = None
+    poison_retries: int = 0
+    poison_victim: bool = False  # chaos poison-request fault: re-NaN this
+    #                              request every hop until it quarantines
+    hedged: bool = False
+    hedge_uid: Optional[str] = None
+    degrade_rung: int = 0
+    replayed: bool = False
     # lifecycle trace (engine-owned)
     phases: Dict[str, float] = dataclasses.field(default_factory=dict)
     deferrals: int = 0
@@ -89,6 +106,22 @@ class Request:
     @property
     def lanes_needed(self) -> int:
         return 2 if self.guided else 1
+
+    @property
+    def deadline_t(self) -> Optional[float]:
+        """Absolute monotonic deadline (None = no deadline)."""
+        if self.deadline_s is None:
+            return None
+        return self.arrival_t + self.deadline_s
+
+    def deadline_frac(self, now: Optional[float] = None) -> Optional[float]:
+        """Fraction of the deadline budget consumed (can exceed 1.0).  The
+        router hedges a request on a stalled replica once this crosses its
+        hedge threshold."""
+        if self.deadline_s is None or self.deadline_s <= 0:
+            return None
+        now = time.monotonic() if now is None else now
+        return (now - self.arrival_t) / self.deadline_s
 
 
 class RequestQueue:
@@ -109,6 +142,13 @@ class RequestQueue:
                 kind="queue_overflow",
             )
         self._q.append(req)
+        obs_metrics.gauge("serving/queue_depth").set(len(self._q))
+
+    def requeue(self, req: Request) -> None:
+        """Head-of-queue reinsertion for a request the engine already held
+        capacity for (a poison retry): exempt from the depth cap — refusing
+        a request the service ACCEPTED would be a silent drop."""
+        self._q.appendleft(req)
         obs_metrics.gauge("serving/queue_depth").set(len(self._q))
 
     def peek(self) -> Optional[Request]:

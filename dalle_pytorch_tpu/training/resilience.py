@@ -715,6 +715,21 @@ FAULT_KINDS = (
     #                       requeue its in-flight requests onto survivors
     #                       and keep serving.  No-op under the training CLIs
     #                       (serving/fleet.py polls take_kill_replica_fault).
+    "kill-fleet",         # durability drill: SIGKILL the WHOLE serve process
+    #                       at fleet iteration N (`kill-fleet@STEP`) — the
+    #                       request journal (--journal DIR) must let a
+    #                       restarted process replay every accepted-but-
+    #                       unacknowledged request (chaos.py crash-replay).
+    "stall-replica",      # wedge (do NOT kill) replica IDX at fleet iteration
+    #                       N (`stall-replica@STEP:IDX`, default replica 0) —
+    #                       its poll() becomes a no-op so heartbeat/progress
+    #                       stall; the router's circuit breaker must open and
+    #                       hedge its past-deadline requests onto survivors.
+    "poison-request",     # poison drill: NaN the decode logits of one lane
+    #                       at engine iteration N (`poison-request@STEP`) —
+    #                       the jit-pure nonfinite screen must quarantine the
+    #                       owning request after K retries while cohabiting
+    #                       lanes stay bit-exact.
 )
 
 
@@ -728,14 +743,21 @@ class Fault:
 def parse_fault(spec: str) -> Fault:
     """`KIND@STEP` (e.g. `kill-process@40`); STEP defaults to 0.  stall-data
     accepts `stall-data@STEP:SECONDS`; flood accepts `flood@STEP:COUNT`
-    (burst size, stored in the same numeric slot); kill-replica accepts
-    `kill-replica@STEP:IDX` (the fleet replica to kill, default 0)."""
+    (burst size, stored in the same numeric slot); kill-replica and
+    stall-replica accept `KIND@STEP:IDX` (the fleet replica to kill or
+    wedge, default 0)."""
     kind, _, at = spec.partition("@")
     if kind not in FAULT_KINDS:
         raise ValueError(
             f"unknown fault kind {kind!r}; choose from {', '.join(FAULT_KINDS)}"
         )
-    stall_s = 32.0 if kind == "flood" else 0.0 if kind == "kill-replica" else 5.0
+    if kind == "flood":
+        stall_s = 32.0
+    elif kind in ("kill-replica", "stall-replica", "kill-fleet",
+                  "poison-request"):
+        stall_s = 0.0
+    else:
+        stall_s = 5.0
     if ":" in at:
         at, _, secs = at.partition(":")
         stall_s = float(secs)  # host-sync-ok: parsing a CLI flag string
@@ -844,6 +866,47 @@ def take_kill_replica_fault(step: int) -> Optional[int]:
         inj.fired = True
         return int(inj.fault.stall_s)  # host-sync-ok: parsed CLI number
     return None
+
+
+def take_kill_fleet_fault(step: int) -> bool:
+    """True exactly once when a `kill-fleet` fault is armed and the serving
+    fleet's iteration counter reaches the fault step — the fleet SIGKILLs the
+    whole process (no cleanup, no terminal records) so the crash-replay drill
+    can prove the request journal recovers every unacknowledged request."""
+    inj = _ACTIVE_INJECTOR
+    if (inj is not None and not inj.fired and inj.fault.kind == "kill-fleet"
+            and step >= inj.fault.step):
+        inj.fired = True
+        return True
+    return False
+
+
+def take_stall_replica_fault(step: int) -> Optional[int]:
+    """The replica index to WEDGE (None = no fault) exactly once when a
+    `stall-replica` fault is armed and the serving fleet's iteration counter
+    reaches the fault step — the replica stays alive but its poll() becomes
+    a no-op, so the router must detect the stalled heartbeat/progress, open
+    its circuit breaker, and hedge past-deadline requests onto survivors."""
+    inj = _ACTIVE_INJECTOR
+    if (inj is not None and not inj.fired and inj.fault.kind == "stall-replica"
+            and step >= inj.fault.step):
+        inj.fired = True
+        return int(inj.fault.stall_s)  # host-sync-ok: parsed CLI number
+    return None
+
+
+def take_poison_fault(step: int) -> bool:
+    """True exactly once when a `poison-request` fault is armed and the
+    serving ENGINE's iteration counter reaches the fault step — the engine
+    NaNs the decode logits of one live lane so the jit-pure nonfinite screen
+    and the quarantine path can be drilled end to end."""
+    inj = _ACTIVE_INJECTOR
+    if (inj is not None and not inj.fired
+            and inj.fault.kind == "poison-request"
+            and step >= inj.fault.step):
+        inj.fired = True
+        return True
+    return False
 
 
 def take_stream_fault() -> bool:

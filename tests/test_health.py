@@ -400,6 +400,8 @@ def test_generate_images_records_inference_metrics(tmp_path):
 # CLI acceptance smoke: --dummy_run --health_every 1 + injected NaN
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: localization itself is covered fast by
+# test_nan_injection_localizes_to_the_right_leaf; this is the CLI smoke
 def test_train_dalle_health_smoke_localizes_injected_nan(tmp_path, monkeypatch):
     import sys
 

@@ -156,6 +156,8 @@ def test_fused_equals_disaggregated_pool_writes(base):
 # numerics parity through the real paged serving path
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget: pool-write parity stays fast via
+# test_fused_equals_disaggregated_pool_writes + the serving paged-parity tests
 def test_greedy_parity_within_declared_budgets(base):
     cfg, params, text = base
     ref = quant.paged_greedy_logits(params, cfg, text)

@@ -388,6 +388,9 @@ def test_rollback_recovers_from_transient_divergence(tmp_path):
     assert all(np.isfinite(v) for v in got.values())
 
 
+@pytest.mark.slow  # tier-1 budget: the rollback mechanism stays fast via
+# test_rollback_recovers_from_transient_divergence; this leg only adds the
+# budget-exhaustion exit path
 def test_rollback_budget_exhaustion_aborts_with_exit_76(tmp_path):
     """A divergence that recurs after every rollback (the injection spec
     repeats) exhausts the bounded retries and aborts CLEANLY with

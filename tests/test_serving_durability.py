@@ -1,0 +1,553 @@
+"""Durable serving (PR 14): journal, deadlines/retries, breaker, quarantine,
+degrade ladder.
+
+The load-bearing properties, all BIT-level where generation is involved:
+
+* **Journal replay exactness** — an accepted-but-unacknowledged request
+  replayed from the fsynced JSONL WAL into a fresh process/engine produces
+  exactly the codes the original submission would have (greedy AND
+  stochastic: the sample path is a pure function of text/key/knobs).
+* **Retry-hop exactness** — a request drained mid-decode off one replica and
+  re-placed on a second completes bit-identically to the fused reference.
+* **Breaker discipline** — a wedged-but-busy replica opens the breaker
+  (one `replica_circuit_open` alarm per episode), half-opens after the probe
+  delay, and closes on recovery; an IDLE wedged replica never trips it.
+* **Poison quarantine** — a persistently-nonfinite request burns its bounded
+  retry budget and is quarantined with a terminal `poisoned` record, while a
+  cohabiting healthy lane's codes stay bit-identical to a solo run.
+* **Ladder hysteresis** — rungs climb only under sustained pressure and
+  descend only after sustained calm; shaping refuses/strips exactly what the
+  rung declares.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.models.sampling import sample_image_codes
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.serving.degrade import RUNGS, DegradeConfig, DegradeLadder
+from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
+from dalle_pytorch_tpu.serving.journal import (ACK_OUTCOMES, RequestJournal,
+                                               request_uid)
+from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused, Request
+from dalle_pytorch_tpu.training import resilience
+
+import jax.numpy as jnp
+
+# effective argmax: gumbel_sample scales the noise by temperature, so a tiny
+# temperature is greedy without the division-by-zero of exactly 0.0
+GREEDY = 1e-4
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32, depth=2, num_text_tokens=64, text_seq_len=8, heads=2,
+        dim_head=8, num_image_tokens=32, image_fmap_size=4, shift_tokens=True,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+def fused_ref(params, cfg, text_row, key, temperature=1.0, cond_scale=1.0):
+    return np.asarray(sample_image_codes(
+        params, cfg, jnp.asarray(text_row)[None], key,
+        filter_thres=0.9, temperature=temperature, cond_scale=cond_scale,
+    ))
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, cfg.text_seq_len), 1, cfg.num_text_tokens))
+    return cfg, params, text
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=2, block_size=4)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# --------------------------------------------------------------- journal
+
+
+def test_request_uid_stable_across_representations():
+    """The content uid ignores dtype/container differences — the same
+    logical request keeps ONE journal identity across requeue hops."""
+    text = np.arange(1, 9, dtype=np.int32)
+    key = np.asarray(jax.random.PRNGKey(3))
+    a = request_uid(text, key, 1.0, 1.0)
+    assert a == request_uid(text.astype(np.int64), list(np.asarray(key)))
+    assert a != request_uid(text, np.asarray(jax.random.PRNGKey(4)))
+    assert a != request_uid(text, key, temperature=0.5)
+
+
+@pytest.mark.parametrize("temperature", [
+    GREEDY,
+    pytest.param(1.0, marks=pytest.mark.slow),  # tier-1 budget: one leg fast
+], ids=["greedy", "stochastic"])
+def test_journal_replay_bit_exact(base, tmp_path, temperature):
+    """Crash replay in miniature: journal an accepted request WITHOUT
+    acking it (the engine 'crashes' before completion), reopen the journal
+    in a new instance (the restart), and resubmit the replay payload to a
+    fresh engine — codes bit-identical to the fused reference."""
+    cfg, params, text = base
+    j = RequestJournal(str(tmp_path))
+    eng = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    eng.journal = j
+    key = jax.random.PRNGKey(21)
+    req = eng.submit(text[0], key=key, temperature=temperature,
+                     deadline_s=9.0, retries_left=2)
+    for _ in range(4):  # a few decode steps, then "crash" (no ack)
+        eng.poll()
+    j.close()
+
+    j2 = RequestJournal(str(tmp_path))
+    assert j2.stats() == {"accepted": 1, "acked": 0, "unacknowledged": 1}
+    payloads = j2.replay()
+    assert len(payloads) == 1
+    p = payloads[0]
+    assert p["uid"] == req.journal_uid
+    assert p["deadline_s"] == 9.0 and p["retries_left"] == 2
+    fresh = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    fresh.journal = j2
+    redone = fresh.submit(p["text"], key=p["key"],
+                          temperature=p["temperature"],
+                          cond_scale=p["cond_scale"], replayed=True)
+    fresh.run_until_idle()
+    want = fused_ref(params, cfg, text[0], key, temperature=temperature)
+    np.testing.assert_array_equal(redone.codes[None], want)
+    # the completion acked the ORIGINAL journal identity
+    assert j2.stats()["unacknowledged"] == 0
+    j2.close()
+
+
+def test_journal_acks_and_duplicate_suppression(base, tmp_path):
+    """A completed request is acked exactly once; the second ack of the
+    same uid (a hedged copy finishing late) is suppressed and counted."""
+    cfg, params, text = base
+    j = RequestJournal(str(tmp_path))
+    eng = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    eng.journal = j
+    req = eng.submit(text[0], key=jax.random.PRNGKey(5))
+    eng.run_until_idle()
+    assert req.outcome == "completed"
+    assert j.stats() == {"accepted": 1, "acked": 1, "unacknowledged": 0}
+    before = obs_metrics.counter("journal/duplicate_acks").value
+    assert j.ack(req, "completed") is False
+    assert obs_metrics.counter("journal/duplicate_acks").value == before + 1
+    j.close()
+    # every terminal outcome class is an ack; "deferred" deliberately is not
+    assert "deferred" not in ACK_OUTCOMES
+
+
+@pytest.mark.slow
+def test_journal_progress_records_rng_position(base, tmp_path):
+    """Progress records carry codes_done == the RNG stream position, at the
+    journal's progress_every cadence, and replay() reports the furthest one."""
+    cfg, params, text = base
+    j = RequestJournal(str(tmp_path), progress_every=4)
+    eng = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    eng.journal = j
+    eng.submit(text[1], key=jax.random.PRNGKey(6))
+    for _ in range(10):
+        eng.poll()
+    j.close()
+    recs = [json.loads(l) for l in open(j.path)]
+    prog = [r for r in recs if r["kind"] == "progress"]
+    assert prog, "no progress records at progress_every=4"
+    assert all(r["codes_done"] == r["rng_pos"] for r in prog)
+    assert all(r["codes_done"] % 4 == 0 for r in prog)
+    j2 = RequestJournal(str(tmp_path))
+    assert j2.replay()[0]["codes_done"] == max(r["codes_done"] for r in prog)
+    j2.close()
+
+
+def test_journal_tolerates_torn_final_line(tmp_path):
+    """A crash mid-append leaves a torn last line: the record was never
+    durable, so the restart scan drops it (counted) and replays the rest."""
+    j = RequestJournal(str(tmp_path))
+    req = Request(id=0, text=np.arange(1, 9), key=np.asarray(
+        jax.random.PRNGKey(8)))
+    j.accepted(req)
+    j.close()
+    with open(j.path, "a") as f:
+        f.write('{"kind":"ack","uid":"' + req.journal_uid)  # torn mid-write
+    before = obs_metrics.counter("journal/torn_records").value
+    j2 = RequestJournal(str(tmp_path))
+    assert obs_metrics.counter("journal/torn_records").value == before + 1
+    assert j2.stats() == {"accepted": 1, "acked": 0, "unacknowledged": 1}
+    assert j2.replay()[0]["uid"] == req.journal_uid
+    j2.close()
+
+
+# ------------------------------------------- satellite: retry-hop exactness
+
+
+@pytest.mark.parametrize("temperature", [
+    GREEDY,
+    pytest.param(1.0, marks=pytest.mark.slow),  # tier-1 budget: one leg fast
+], ids=["greedy", "stochastic"])
+def test_retry_on_second_replica_bit_exact(base, temperature):
+    """Satellite: a request drained mid-decode off replica A (lost) and
+    re-placed on replica B completes bit-identically to the fused
+    single-engine reference — greedy AND stochastic."""
+    cfg, params, text = base
+    fleet = ServingFleet(params, cfg,
+                         fleet_cfg=FleetConfig(replicas=2, engine=_ecfg()))
+    key = jax.random.PRNGKey(33)
+    req = fleet.submit(text[2], key=key, temperature=temperature,
+                       retries_left=3)
+    holder = next(i for i, e in enumerate(fleet.engines)
+                  if any(r is req for r in
+                         list(e._inflight) + list(e.queue._q)))
+    while req.codes_done == 0:  # catch it MID-decode, not still queued
+        fleet.engines[holder].poll()
+    assert 0 < req.codes_done < cfg.image_seq_len
+    requeued = fleet.kill_replica(holder)
+    assert len(requeued) == 1
+    # the retry hop consumed one unit of the bounded retry budget
+    assert requeued[0].retries_left == 2
+    fleet.run_until_idle()
+    want = fused_ref(params, cfg, text[2], key, temperature=temperature)
+    np.testing.assert_array_equal(requeued[0].codes[None], want)
+
+
+def test_requeue_exhausted_when_retry_budget_spent(base):
+    """Satellite: mark_lost no longer blocks forever — an export whose
+    retry budget is spent is shed with a terminal `requeue_exhausted`
+    record, counted and alarmed, instead of spinning against survivors."""
+    cfg, params, text = base
+    alarms = []
+    fleet = ServingFleet(params, cfg,
+                         fleet_cfg=FleetConfig(replicas=2, engine=_ecfg()),
+                         on_alarm=alarms.append)
+    req = fleet.submit(text[0], key=jax.random.PRNGKey(44), retries_left=0)
+    holder = next(i for i, e in enumerate(fleet.engines)
+                  if any(r is req for r in
+                         list(e._inflight) + list(e.queue._q)))
+    before = obs_metrics.counter("router/requeue_exhausted").value
+    requeued = fleet.kill_replica(holder)
+    assert requeued == []
+    assert obs_metrics.counter("router/requeue_exhausted").value == before + 1
+    kinds = [a["type"] for a in alarms]
+    assert kinds == ["replica_lost", "requeue_exhausted"]
+    assert alarms[1]["shed"] == 1 and alarms[1]["requeued"] == 0
+    fleet.run_until_idle()
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_opens_half_opens_closes(base):
+    """The full breaker episode: a wedged replica WITH work opens the
+    breaker after stall_after_s (one alarm), half-opens after probe_after_s,
+    and closes the moment its iteration counter advances again — with the
+    stuck request still completing bit-exactly after recovery."""
+    cfg, params, text = base
+    alarms = []
+    fleet = ServingFleet(
+        params, cfg,
+        fleet_cfg=FleetConfig(replicas=2, engine=_ecfg(),
+                              stall_after_s=0.1, probe_after_s=0.15),
+        on_alarm=alarms.append)
+    key = jax.random.PRNGKey(55)
+    req = fleet.submit(text[3], key=key)
+    victim = next(i for i, e in enumerate(fleet.engines)
+                  if any(r is req for r in
+                         list(e._inflight) + list(e.queue._q)))
+    fleet.engines[victim].wedge(0.6)
+
+    def _state():
+        return fleet.router._breaker[victim]["state"]
+
+    t0 = time.monotonic()
+    while _state() != "open":
+        assert time.monotonic() - t0 < 30.0, "breaker never opened"
+        fleet.poll()
+    while _state() != "half_open":
+        assert time.monotonic() - t0 < 30.0, "breaker never half-opened"
+        fleet.poll()
+    while _state() != "closed":  # wedge expires -> iter advances -> closed
+        assert time.monotonic() - t0 < 30.0, "breaker never closed"
+        fleet.poll()
+    fleet.run_until_idle()
+    assert [a["type"] for a in alarms] == ["replica_circuit_open"]
+    assert alarms[0]["replica"] == victim
+    np.testing.assert_array_equal(req.codes[None],
+                                  fused_ref(params, cfg, text[3], key))
+
+
+def test_idle_wedged_replica_never_trips_breaker(base):
+    """A wedged replica with NO work is indistinguishable from idle — the
+    breaker must not open (progress-or-idle closes)."""
+    cfg, params, text = base
+    fleet = ServingFleet(
+        params, cfg,
+        fleet_cfg=FleetConfig(replicas=2, engine=_ecfg(),
+                              stall_after_s=0.05))
+    fleet.engines[1].wedge(0.3)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.4:
+        fleet.poll()
+    assert fleet.router._breaker[1]["state"] == "closed"
+
+
+@pytest.mark.slow
+def test_hedge_first_completion_wins(base):
+    """A deadline-carrying request stuck on a wedged replica is hedged onto
+    a survivor past hedge_frac of its budget; the winner's codes are the
+    fused reference's, and the loser is suppressed (never delivered twice)."""
+    cfg, params, text = base
+    fleet = ServingFleet(
+        params, cfg,
+        fleet_cfg=FleetConfig(replicas=2, engine=_ecfg(),
+                              stall_after_s=0.05, probe_after_s=10.0,
+                              hedge_frac=0.1))
+    # warm the survivor path first so compile latency cannot eat the wedge
+    fleet.submit(text[0], key=jax.random.PRNGKey(70), synthetic=True)
+    fleet.run_until_idle()
+    key = jax.random.PRNGKey(66)
+    req = fleet.submit(text[1], key=key, deadline_s=1.0)
+    victim = next(i for i, e in enumerate(fleet.engines)
+                  if any(r is req for r in
+                         list(e._inflight) + list(e.queue._q)))
+    fleet.engines[victim].wedge(1.5)
+    before_h = obs_metrics.counter("router/hedged").value
+    before_d = obs_metrics.counter("router/hedge_duplicates").value
+    delivered = []
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 8.0:
+        delivered.extend(fleet.poll())
+        if delivered and not fleet.busy:
+            break
+    assert obs_metrics.counter("router/hedged").value == before_h + 1
+    winners = [r for r in delivered if getattr(r, "hedge_uid", None)]
+    assert len(winners) == 1, "hedged pair must deliver exactly once"
+    np.testing.assert_array_equal(winners[0].codes[None],
+                                  fused_ref(params, cfg, text[1], key))
+    # the wedged original limps in afterwards and is suppressed
+    fleet.run_until_idle()
+    assert (obs_metrics.counter("router/hedge_duplicates").value
+            == before_d + 1)
+
+
+# ----------------------------------------------------- poison quarantine
+
+
+def test_poison_quarantined_after_bounded_retries_cohab_exact(base):
+    """A persistently-poisoned request burns poison_max_retries retry hops
+    then quarantines with a terminal `poisoned` outcome; the cohabiting
+    healthy request's codes are bit-identical to a solo run."""
+    cfg, params, text = base
+    eng = GenerationEngine(params, cfg,
+                           engine_cfg=_ecfg(poison_max_retries=2))
+    key = jax.random.PRNGKey(88)
+    victim = eng.submit(text[0], key=jax.random.PRNGKey(87))
+    victim.poison_victim = True
+    cohab = eng.submit(text[1], key=key)
+    before = obs_metrics.counter("serving/quarantined").value
+    eng.run_until_idle()
+    assert victim.outcome == "poisoned"
+    assert victim.codes is None
+    assert victim.poison_retries == 2
+    assert obs_metrics.counter("serving/quarantined").value == before + 1
+    assert cohab.outcome == "completed"
+    np.testing.assert_array_equal(cohab.codes[None],
+                                  fused_ref(params, cfg, text[1], key))
+
+
+@pytest.mark.slow
+def test_transient_nonfinite_retries_clean(base):
+    """A TRANSIENT nonfinite (the poison clears after the first retry hop)
+    costs a retry, not the request: the clean re-decode restarts the RNG
+    stream from scratch and completes bit-exactly."""
+    cfg, params, text = base
+    eng = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    key = jax.random.PRNGKey(91)
+    req = eng.submit(text[2], key=key)
+    req.poison_victim = True
+    while req.poison_retries == 0:  # burn exactly one poisoned hop
+        eng.poll()
+    req.poison_victim = False
+    eng.run_until_idle()
+    assert req.outcome == "completed"
+    assert req.poison_retries == 1
+    np.testing.assert_array_equal(req.codes[None],
+                                  fused_ref(params, cfg, text[2], key))
+
+
+# ------------------------------------------------------- degrade ladder
+
+
+def test_degrade_ladder_hysteresis():
+    """Rungs climb one per sustained-pressure window and descend one per
+    sustained-calm window; samples between the thresholds reset BOTH
+    timers (a noisy queue cannot flap the ladder)."""
+    lad = DegradeLadder(DegradeConfig(enter_after_s=1.0, exit_after_s=2.0),
+                        text_seq_len=8)
+    t = 100.0
+    assert lad.observe(0.9, now=t) == 0          # pressure starts the timer
+    assert lad.observe(0.9, now=t + 0.5) == 0    # not sustained yet
+    assert lad.observe(0.9, now=t + 1.0) == 1    # climbed
+    assert lad.observe(0.9, now=t + 1.5) == 1    # one rung per window
+    assert lad.observe(0.9, now=t + 2.0) == 2
+    # mid-band sample resets both timers
+    assert lad.observe(0.5, now=t + 2.5) == 2
+    assert lad.observe(0.9, now=t + 3.0) == 2    # pressure timer restarted
+    assert lad.observe(0.1, now=t + 4.0) == 2    # calm starts
+    assert lad.observe(0.1, now=t + 5.9) == 2    # exit_after_s not reached
+    assert lad.observe(0.1, now=t + 6.0) == 1    # descended
+    assert lad.observe(0.1, now=t + 8.0) == 0
+    assert lad.max_rung_seen == 2
+    assert lad.rungs_entered == {"no_cfg": 1, "cap_candidates": 1}
+
+
+def test_degrade_shaping_per_rung():
+    """Each rung trades exactly what it declares: rung 1 strips CFG (and
+    halves the lane need), rung 3 refuses long prompts, rung 4 sheds all."""
+    lad = DegradeLadder(DegradeConfig(short_prompt_max=3), text_seq_len=8)
+
+    def mk(cond_scale=1.0, n_tok=8):
+        txt = np.zeros(8, np.int32)
+        txt[:n_tok] = 1
+        return Request(id=0, text=txt, key=np.asarray(jax.random.PRNGKey(1)),
+                       cond_scale=cond_scale)
+
+    req = mk(cond_scale=3.0)
+    lad.shape_request(req)                       # rung 0: untouched
+    assert req.cond_scale == 3.0 and req.degrade_rung == 0
+
+    lad.rung = 1
+    req = mk(cond_scale=3.0)
+    assert req.lanes_needed == 2
+    lad.shape_request(req)
+    assert req.cond_scale == 1.0 and req.lanes_needed == 1
+    assert req.degrade_rung == 1
+
+    lad.rung = 3
+    with pytest.raises(AdmissionRefused) as ei:
+        lad.shape_request(mk(n_tok=5))
+    assert ei.value.kind == "degraded_long_prompt"
+    lad.shape_request(mk(n_tok=3))               # short prompt still admitted
+
+    lad.rung = 4
+    with pytest.raises(AdmissionRefused) as ei:
+        lad.shape_request(mk(n_tok=1))
+    assert ei.value.kind == "degraded_shed"
+    assert RUNGS[4] == "shed"
+
+
+@pytest.mark.slow
+def test_degrade_shed_is_counted_refusal(base):
+    """An engine with the ladder at rung 4 refuses submits under the
+    `degraded_shed` class and still serves after the ladder descends."""
+    cfg, params, text = base
+    eng = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    eng.degrade = DegradeLadder(DegradeConfig(), text_seq_len=cfg.text_seq_len)
+    eng.degrade.rung = 4
+    before = obs_metrics.counter("serving/refused_degraded_shed").value
+    with pytest.raises(AdmissionRefused):
+        eng.submit(text[0], key=jax.random.PRNGKey(9))
+    assert (obs_metrics.counter("serving/refused_degraded_shed").value
+            == before + 1)
+    eng.degrade.rung = 0
+    key = jax.random.PRNGKey(10)
+    req = eng.submit(text[0], key=key)
+    eng.run_until_idle()
+    assert req.degrade_rung == 0
+    np.testing.assert_array_equal(req.codes[None],
+                                  fused_ref(params, cfg, text[0], key))
+
+
+# -------------------------------------------------------- fault parsing
+
+
+def test_kill_fleet_fault_parse_and_fire():
+    """kill-fleet@ITER parses into the fault seam and fires ONCE."""
+    f = resilience.parse_fault("kill-fleet@4")
+    assert f.kind == "kill-fleet" and f.step == 4
+    inj = resilience.FaultInjector(f).install()
+    try:
+        assert resilience.take_kill_fleet_fault(3) is False
+        assert resilience.take_kill_fleet_fault(4) is True
+        assert resilience.take_kill_fleet_fault(5) is False  # fired once
+    finally:
+        inj.uninstall()
+
+
+def test_stall_replica_fault_parse_and_fire():
+    """stall-replica@ITER:IDX parses (victim index rides in stall_s) and
+    fires ONCE."""
+    f = resilience.parse_fault("stall-replica@6:1")
+    assert f.kind == "stall-replica" and f.step == 6 and f.stall_s == 1
+    inj = resilience.FaultInjector(f).install()
+    try:
+        assert resilience.take_stall_replica_fault(5) is None
+        assert resilience.take_stall_replica_fault(6) == 1
+        assert resilience.take_stall_replica_fault(7) is None
+    finally:
+        inj.uninstall()
+    assert resilience.parse_fault("stall-replica@2").stall_s == 0.0
+
+
+def test_poison_request_fault_parse_and_fire():
+    """poison-request@ITER parses into the fault seam and fires ONCE."""
+    f = resilience.parse_fault("poison-request@9")
+    assert f.kind == "poison-request" and f.step == 9
+    inj = resilience.FaultInjector(f).install()
+    try:
+        assert resilience.take_poison_fault(8) is False
+        assert resilience.take_poison_fault(9) is True
+        assert resilience.take_poison_fault(10) is False
+    finally:
+        inj.uninstall()
+
+
+# ------------------------------------------------------------ slow tier
+
+
+def _tools():
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).resolve().parent.parent / "tools"))
+
+
+@pytest.mark.slow
+def test_chaos_crash_replay_drill(tmp_path):
+    """Full durability drill: SIGKILL the serve process mid-load with
+    --journal, restart, every accepted-unacked request completes with zero
+    duplicate acks."""
+    _tools()
+    from chaos import crash_replay_drill
+
+    assert crash_replay_drill(workdir=str(tmp_path)) == 0
+
+
+@pytest.mark.slow
+def test_chaos_stall_replica_drill(tmp_path):
+    """Full breaker drill: wedge one replica mid-load — breaker opens
+    (one alarm), hedged requests complete on survivors, breaker recovers."""
+    _tools()
+    from chaos import stall_replica_drill
+
+    assert stall_replica_drill(workdir=str(tmp_path)) == 0
+
+
+@pytest.mark.slow
+def test_chaos_poison_drill(tmp_path):
+    """Full quarantine drill: one poisoned request is quarantined after
+    bounded retries while every healthy request completes."""
+    _tools()
+    from chaos import poison_drill
+
+    assert poison_drill(workdir=str(tmp_path)) == 0

@@ -122,6 +122,48 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", type=str, default=None)
 
     p = sub.add_parser(
+        "crash-replay",
+        help="durable-serving crash drill: SIGKILL the whole serve process "
+             "mid-load (kill-fleet@AT) with --journal armed, restart with "
+             "the same journal; every accepted-but-unacknowledged request "
+             "must replay to completion with zero duplicate acks")
+    p.add_argument("--requests", type=int, default=4)
+    p.add_argument("--at", type=int, default=10,
+                   help="engine iteration the SIGKILL fires at")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--workdir", type=str, default=None)
+
+    p = sub.add_parser(
+        "stall-replica",
+        help="circuit-breaker drill: wedge one replica alive-but-stalled "
+             "mid-run (stall-replica@AT:IDX); the breaker must open (one "
+             "replica_circuit_open alarm), deadline-burning requests hedge "
+             "onto survivors (first-completion-wins), and the breaker must "
+             "half-open and recover once the wedge expires")
+    p.add_argument("--requests", type=int, default=6)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--at", type=int, default=8,
+                   help="fleet iteration the wedge fires at")
+    p.add_argument("--victim", type=int, default=1,
+                   help="replica index to wedge")
+    p.add_argument("--wedge_s", type=float, default=2.0,
+                   help="how long the victim stays wedged")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--workdir", type=str, default=None)
+
+    p = sub.add_parser(
+        "poison",
+        help="poison-quarantine drill: NaN one in-flight request's decode "
+             "logits (poison-request@AT); the engine must retry it K times, "
+             "quarantine it with a terminal `poisoned` record, and complete "
+             "every other request undisturbed")
+    p.add_argument("--requests", type=int, default=4)
+    p.add_argument("--at", type=int, default=6,
+                   help="engine iteration the poison fires at")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--workdir", type=str, default=None)
+
+    p = sub.add_parser(
         "kill-replica",
         help="serving fleet preemption drill: 2 replicas under Poisson "
              "load, kill one mid-run via kill-replica@ITER:IDX; every "
@@ -178,6 +220,22 @@ def main(argv=None) -> int:
             requests=args.requests, replicas=args.replicas, at=args.at,
             victim=args.victim, disaggregate=args.disaggregate,
             slots=args.slots, workdir=args.workdir,
+        )
+    elif args.cmd == "crash-replay":
+        return crash_replay_drill(
+            requests=args.requests, at=args.at, slots=args.slots,
+            workdir=args.workdir,
+        )
+    elif args.cmd == "stall-replica":
+        return stall_replica_drill(
+            requests=args.requests, replicas=args.replicas, at=args.at,
+            victim=args.victim, wedge_s=args.wedge_s, slots=args.slots,
+            workdir=args.workdir,
+        )
+    elif args.cmd == "poison":
+        return poison_drill(
+            requests=args.requests, at=args.at, slots=args.slots,
+            workdir=args.workdir,
         )
     return 0
 
@@ -446,6 +504,252 @@ def kill_replica_drill(requests=6, replicas=2, at=4, victim=0,
           f"(all {requests} accounted for), "
           f"{report.get('requeued_total', 0):.0f} requeued onto survivors, "
           f"p99 TTFT {report['ttft_p99_s']:.3f}s — zero drops, no crash")
+    return 0
+
+
+# tiny random-init model every serving drill uses (seconds on CPU)
+_TINY_MODEL = ["--synthetic", "--dim", "32", "--depth", "2", "--heads", "2",
+               "--dim_head", "8", "--text_seq_len", "8",
+               "--num_text_tokens", "64", "--num_image_tokens", "32",
+               "--image_fmap_size", "4"]
+
+
+def _serve_env():
+    """Env scrub shared by the serving drills: force CPU, drop any inherited
+    accelerator pool, and put the repo root on PYTHONPATH."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    return env
+
+
+def crash_replay_drill(requests=4, at=10, slots=2, workdir=None,
+                       timeout=600) -> int:
+    """Durable-serving crash drill: phase 1 runs the serve CLI under Poisson
+    load with `--journal` armed and `--inject_fault kill-fleet@AT` — the
+    process SIGKILLs ITSELF mid-load (no cleanup, no close(): the hard-crash
+    case the journal exists for).  Phase 2 restarts with the SAME journal
+    directory and no other traffic: every accepted-but-unacknowledged
+    request must replay to completion (replay is a plain resubmit of
+    (text, key, knobs); the per-request RNG stream regenerates the exact
+    codes the crashed process was producing) with ZERO duplicate acks.
+    Returns 0 on success."""
+    import json
+    import subprocess
+    import tempfile
+
+    cwd = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="crashrep_"))
+    cwd.mkdir(parents=True, exist_ok=True)
+    jdir = cwd / "journal"
+    report_path = cwd / "crash_replay_report.json"
+    env = _serve_env()
+    base = [sys.executable, "-m", "dalle_pytorch_tpu.cli.serve",
+            *_TINY_MODEL, "--slots", str(slots), "--block_size", "8",
+            "--no_vae", "--journal", str(jdir)]
+    print(f"[crash-replay] phase 1: {requests} Poisson requests, SIGKILL "
+          f"at engine iteration {at} (journal {jdir})")
+    a = subprocess.run(
+        [*base, "--loadgen", str(requests), "--rate", "50", "--streams", "2",
+         "--inject_fault", f"kill-fleet@{at}"],
+        cwd=str(cwd), env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if a.returncode != -signal.SIGKILL:
+        print(f"[crash-replay] FAIL: expected SIGKILL death, got "
+              f"rc={a.returncode}\n{a.stderr[-2000:]}")
+        return 1
+    recs = [json.loads(ln) for ln in
+            (jdir / "journal.jsonl").read_text().splitlines() if ln.strip()]
+    accepted = {r["uid"] for r in recs if r["kind"] == "accepted"}
+    acked = {r["uid"] for r in recs if r["kind"] == "ack"}
+    unacked = accepted - acked
+    if not accepted or not unacked:
+        print(f"[crash-replay] FAIL: the crash left {len(accepted)} accepted"
+              f" / {len(unacked)} unacknowledged — the kill did not "
+              "interrupt in-flight work (tune --at)")
+        return 1
+    print(f"[crash-replay] crash left {len(accepted)} accepted, "
+          f"{len(acked)} acked, {len(unacked)} unacknowledged")
+    print("[crash-replay] phase 2: restart with the same --journal, no new "
+          "traffic — the journal IS the traffic source")
+    b = subprocess.run(
+        [*base, "--report_json", str(report_path)],
+        cwd=str(cwd), env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if b.returncode != 0:
+        print(f"[crash-replay] FAIL: restart rc={b.returncode}\n"
+              f"{b.stderr[-2000:]}")
+        return 1
+    report = json.loads(report_path.read_text())
+    checks = [
+        ("journal_replayed", len(unacked)),
+        ("journal_replay_completed", len(unacked)),
+        ("journal_unacknowledged", 0),
+        ("journal_duplicate_acks", 0),
+    ]
+    for key, want in checks:
+        if report.get(key) != want:
+            print(f"[crash-replay] FAIL: {key}={report.get(key)} != {want}"
+                  f"\n{b.stdout[-2000:]}")
+            return 1
+    print(f"[crash-replay] OK: all {len(unacked)} unacknowledged request(s) "
+          f"replayed to completion after a hard SIGKILL; zero duplicate "
+          f"acks, journal fully acknowledged "
+          f"({report['journal_acked']}/{report['journal_accepted']})")
+    return 0
+
+
+def stall_replica_drill(requests=6, replicas=2, at=8, victim=1, wedge_s=2.0,
+                        slots=2, workdir=None, timeout=600) -> int:
+    """Circuit-breaker drill: wedge replica VICTIM alive-but-stalled for
+    `wedge_s` mid-run (`--inject_fault stall-replica@AT:VICTIM` — its poll()
+    becomes a no-op; the process never dies, so mark_lost never fires) under
+    deadline-carrying Poisson load, then verify the breaker story: it trips
+    open with exactly ONE `replica_circuit_open` alarm (episode discipline),
+    deadline-burning requests hedge onto the survivors with first-
+    completion-wins dedup, and once the wedge expires the breaker half-
+    opens, sees progress, and closes — nobody is marked lost, nothing is
+    dropped.  Returns 0 on success."""
+    import json
+    import subprocess
+    import tempfile
+
+    cwd = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="stallrep_"))
+    cwd.mkdir(parents=True, exist_ok=True)
+    report_path = cwd / "stall_replica_report.json"
+    tele_dir = cwd / "tele"
+    env = _serve_env()
+    print(f"[stall-replica] serve CLI: {requests} Poisson requests across "
+          f"{replicas} replicas, wedging replica {victim} for {wedge_s}s at "
+          f"fleet iteration {at}; workdir {cwd}")
+    r = subprocess.run(
+        [sys.executable, "-m", "dalle_pytorch_tpu.cli.serve",
+         *_TINY_MODEL, "--loadgen", str(requests), "--rate", "20",
+         "--streams", "2", "--slots", str(slots), "--block_size", "8",
+         "--no_vae", "--replicas", str(replicas),
+         "--deadline_s", "2.0", "--stall_wedge_s", str(wedge_s),
+         "--stall_after_s", "0.3", "--hedge_frac", "0.25",
+         "--inject_fault", f"stall-replica@{at}:{victim}",
+         "--telemetry", str(tele_dir), "--telemetry_every", "4",
+         "--report_json", str(report_path)],
+        cwd=str(cwd), env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if r.returncode != 0:
+        print(f"[stall-replica] FAIL: serve rc={r.returncode}\n"
+              f"{r.stderr[-2000:]}")
+        return 1
+    report = json.loads(report_path.read_text())
+    done = report["requests_completed"]
+    refused = report["requests_refused"]
+    if done + refused < requests:
+        print(f"[stall-replica] FAIL: {done} completed + {refused} refused "
+              f"< {requests} arrivals — requests were lost behind the wedge"
+              f"\n{r.stdout[-2000:]}")
+        return 1
+    if report.get("replicas_lost", 0) != 0 or (
+            report.get("replicas_alive") != replicas):
+        print(f"[stall-replica] FAIL: a stalled replica must NOT be marked "
+              f"lost (lost={report.get('replicas_lost')}, "
+              f"alive={report.get('replicas_alive')})")
+        return 1
+    if not report.get("breaker_opens"):
+        print("[stall-replica] FAIL: the breaker never opened on the "
+              "wedged replica")
+        return 1
+    if not report.get("breaker_recoveries"):
+        print("[stall-replica] FAIL: the breaker never closed again after "
+              "the wedge expired")
+        return 1
+    if not report.get("hedged"):
+        print("[stall-replica] FAIL: no deadline-burning request was hedged "
+              "off the stalled replica")
+        return 1
+    spans_path = tele_dir / "serve.spans.jsonl"
+    records = [json.loads(ln) for ln in spans_path.read_text().splitlines()
+               if ln.strip()]
+    breaker_alarms = [rec for rec in records if rec.get("kind") == "alarm"
+                      and rec.get("type") == "replica_circuit_open"]
+    if len(breaker_alarms) != 1:
+        print(f"[stall-replica] FAIL: expected exactly 1 "
+              f"replica_circuit_open alarm, got {len(breaker_alarms)}")
+        return 1
+    if breaker_alarms[0].get("replica") != victim:
+        print(f"[stall-replica] FAIL: alarm blames replica "
+              f"{breaker_alarms[0].get('replica')}, not the victim {victim}")
+        return 1
+    print(f"[stall-replica] OK: {done} completed + {refused} refused (all "
+          f"{requests} accounted for); breaker opened "
+          f"{report['breaker_opens']:.0f}x and recovered "
+          f"{report['breaker_recoveries']:.0f}x on replica {victim}, "
+          f"{report['hedged']:.0f} hedged "
+          f"({report['hedge_duplicates']:.0f} duplicate completions "
+          f"suppressed), 1 replica_circuit_open alarm — no replica lost")
+    return 0
+
+
+def poison_drill(requests=4, at=6, slots=2, workdir=None,
+                 timeout=600) -> int:
+    """Poison-quarantine drill: `--inject_fault poison-request@AT` NaNs one
+    in-flight request's decode logits inside the jit (re-poisoned every
+    retry hop — a persistently-bad request).  The engine must retry it
+    `poison_max_retries` times, then quarantine it with a terminal
+    `poisoned` record, while every OTHER request completes undisturbed (the
+    injection is a per-lane where, so cohabiting lanes are bit-identical to
+    an uninjected run — pinned exactly in tests/test_serving_durability.py).
+    Returns 0 on success."""
+    import json
+    import subprocess
+    import tempfile
+
+    cwd = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="poison_"))
+    cwd.mkdir(parents=True, exist_ok=True)
+    report_path = cwd / "poison_report.json"
+    tele_dir = cwd / "tele"
+    env = _serve_env()
+    print(f"[poison] serve CLI: {requests} Poisson requests, poisoning one "
+          f"at engine iteration {at}; workdir {cwd}")
+    r = subprocess.run(
+        [sys.executable, "-m", "dalle_pytorch_tpu.cli.serve",
+         *_TINY_MODEL, "--loadgen", str(requests), "--rate", "20",
+         "--streams", "2", "--slots", str(slots), "--block_size", "8",
+         "--no_vae", "--inject_fault", f"poison-request@{at}",
+         "--telemetry", str(tele_dir), "--telemetry_every", "4",
+         "--report_json", str(report_path)],
+        cwd=str(cwd), env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if r.returncode != 0:
+        print(f"[poison] FAIL: serve rc={r.returncode}\n{r.stderr[-2000:]}")
+        return 1
+    report = json.loads(report_path.read_text())
+    if report.get("quarantined") != 1:
+        print(f"[poison] FAIL: quarantined={report.get('quarantined')} != 1"
+              f"\n{r.stdout[-2000:]}")
+        return 1
+    if not report.get("poison_retries"):
+        print("[poison] FAIL: the poisoned request was never retried before "
+              "quarantine")
+        return 1
+    if report["requests_completed"] != requests - 1:
+        print(f"[poison] FAIL: {report['requests_completed']} completed != "
+              f"{requests - 1} — a healthy request was disturbed")
+        return 1
+    spans_path = tele_dir / "serve.spans.jsonl"
+    records = [json.loads(ln) for ln in spans_path.read_text().splitlines()
+               if ln.strip()]
+    poisoned_recs = [rec for rec in records if rec.get("kind") == "request"
+                     and rec.get("outcome") == "poisoned"]
+    if len(poisoned_recs) != 1:
+        print(f"[poison] FAIL: expected exactly 1 terminal `poisoned` "
+              f"record, got {len(poisoned_recs)}")
+        return 1
+    print(f"[poison] OK: 1 request quarantined after "
+          f"{report['poison_retries']:.0f} retries (terminal `poisoned` "
+          f"record, reason={poisoned_recs[0].get('reason')!r}); the other "
+          f"{requests - 1} completed undisturbed")
     return 0
 
 

@@ -72,8 +72,11 @@ JIT_PURE = (
     # (one stray sync there stalls EVERY in-flight request each step); the
     # scheduler's deliberate host work — TTFT blocking, pulling finished
     # codes, CLI scalars — is waived line-by-line.  The directory target
-    # also covers router.py (placement must read only host-held load) and
-    # fleet.py (prefill handoff dispatch + drain/requeue bookkeeping)
+    # also covers router.py (placement/breaker/hedging must read only
+    # host-held load), fleet.py (prefill handoff dispatch + drain/requeue
+    # bookkeeping), journal.py (the WAL is host file I/O only — recording
+    # progress must never force a device pull), and degrade.py (the ladder
+    # is pure host bookkeeping over values the caller already holds)
     "dalle_pytorch_tpu/serving",
     # the SLO monitor runs on the engine's poll thread at window cadence —
     # it must stay pure host arithmetic over the metrics registry (it never

@@ -113,20 +113,29 @@ class PoissonLoadGen:
 
 
 def synthetic_request_maker(cfg, seed: int = 0, temperature: float = 1.0,
-                            cond_scale: float = 1.0):
-    """Random-prompt submit() kwargs factory (drills, bench, smoke tests)."""
+                            cond_scale: float = 1.0,
+                            deadline_s: Optional[float] = None,
+                            retries: Optional[int] = None):
+    """Random-prompt submit() kwargs factory (drills, bench, smoke tests).
+    `deadline_s`/`retries` attach the PR 14 durability budget to every
+    request (hedge eligibility + bounded requeue hops)."""
     import jax
 
     rng = np.random.RandomState(seed)
 
     def make(i: int) -> Dict[str, Any]:
-        return {
+        kw = {
             "text": rng.randint(1, cfg.num_text_tokens,
                                 size=(cfg.text_seq_len,)),
             "key": jax.random.PRNGKey(seed * 100003 + i),
             "temperature": temperature,
             "cond_scale": cond_scale,
         }
+        if deadline_s is not None:
+            kw["deadline_s"] = deadline_s
+        if retries is not None:
+            kw["retries_left"] = retries
+        return kw
 
     return make
 

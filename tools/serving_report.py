@@ -28,7 +28,12 @@ Sections, all from the stream serving/engine.py writes:
 * **fleet** — when request records carry a `replica` tag (serving/fleet.py
   runs), a per-replica outcome/latency breakdown plus the `replica_lost`
   drain/requeue story.  Multiple paths merge into one report (per-replica
-  telemetry dirs, or one combined stream).
+  telemetry dirs, or one combined stream);
+* **durability** — the PR 14 story: terminal `poisoned` /
+  `requeue_exhausted` outcomes, `replica_circuit_open` breaker episodes,
+  hedged requests and suppressed duplicate completions, journal-replayed
+  requests, and the degrade ladder's rung transitions plus how many
+  requests were admitted under each rung (`degrade_rung` request tags).
 
 Pure stdlib; works on a partially-written file from a live run."""
 from __future__ import annotations
@@ -157,6 +162,58 @@ def _quant_section(windows: List[Dict[str, Any]],
     return out
 
 
+RUNG_NAMES = ("normal", "no_cfg", "cap_candidates", "short_prompts", "shed")
+
+
+def _durability_section(records: List[Dict[str, Any]],
+                        reqs: List[Dict[str, Any]]) -> List[str]:
+    """Breaker episodes, hedging, journal replay, and the degrade ladder —
+    everything the durable-serving layer did to keep the run alive."""
+    breaker = [r for r in records if r.get("kind") == "alarm"
+               and r.get("type") == "replica_circuit_open"]
+    rq_alarms = [r for r in records if r.get("kind") == "alarm"
+                 and r.get("type") == "requeue_exhausted"]
+    rungs = [r for r in records if r.get("kind") == "degrade_rung"]
+    hedged = [r for r in reqs if r.get("hedged")]
+    dups = [r for r in reqs if r.get("duplicate")]
+    replayed = [r for r in reqs if r.get("replayed")]
+    by_rung: Dict[int, int] = {}
+    for r in reqs:
+        rung = r.get("degrade_rung")
+        if rung:
+            by_rung[rung] = by_rung.get(rung, 0) + 1
+    if not (breaker or rq_alarms or rungs or hedged or replayed):
+        return []
+    out = ["", "durability:"]
+    for a in breaker:
+        out.append(f"  circuit open: replica {a.get('replica')} stalled "
+                   f"{a.get('stalled_s', '?')}s with "
+                   f"{a.get('inflight', 0)} in flight + "
+                   f"{a.get('queued', 0)} queued")
+    if hedged or dups:
+        out.append(f"  hedging: {len(hedged)} request record(s) hedged, "
+                   f"{len(dups)} duplicate completion(s) suppressed "
+                   f"(first-completion-wins)")
+    if replayed:
+        out.append(f"  journal: {len(replayed)} request(s) replayed from a "
+                   f"previous process generation")
+    for a in rq_alarms:
+        out.append(f"  requeue exhausted: replica {a.get('replica')} — "
+                   f"{a.get('shed', 0)} shed after the "
+                   f"{a.get('budget_s', '?')}s requeue budget "
+                   f"({a.get('requeued', 0)} made it to survivors)")
+    if rungs:
+        peak = max(r.get("rung", 0) for r in rungs)
+        last = rungs[-1]
+        out.append(f"  degrade ladder: {len(rungs)} transition(s), peak "
+                   f"rung {peak} ({RUNG_NAMES[min(peak, 4)]}), final rung "
+                   f"{last.get('rung')} ({last.get('name')})")
+        for rung in sorted(by_rung):
+            out.append(f"    rung {rung} ({RUNG_NAMES[min(rung, 4)]}): "
+                       f"{by_rung[rung]} request(s) admitted under it")
+    return out
+
+
 def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     reqs = [r for r in records
             if r.get("kind") in ("request", "serving_request")]
@@ -175,6 +232,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     done = [r for r in reqs if r.get("outcome", "completed") == "completed"]
     shed = [r for r in reqs if r.get("outcome") == "shed"]
     deferred = [r for r in reqs if r.get("outcome") == "deferred"]
+    poisoned = [r for r in reqs if r.get("outcome") == "poisoned"]
+    exhausted = [r for r in reqs if r.get("outcome") == "requeue_exhausted"]
     if reqs:
         ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
         lats = [r["latency_s"] for r in done if r.get("latency_s") is not None]
@@ -187,7 +246,10 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
         out.append(f"requests: {len(done)} completed "
                    f"({guided} guided, {synth} synthetic)"
                    + (f", {len(shed)} shed" if shed else "")
-                   + (f", {len(deferred)} deferred" if deferred else ""))
+                   + (f", {len(deferred)} deferred" if deferred else "")
+                   + (f", {len(poisoned)} poisoned" if poisoned else "")
+                   + (f", {len(exhausted)} requeue-exhausted"
+                      if exhausted else ""))
         out.append(f"  TTFT     p50 {_ms(_pct(ttfts, 0.50))}   "
                    f"p99 {_ms(_pct(ttfts, 0.99))}")
         out.append(f"  latency  p50 {_ms(_pct(lats, 0.50))}   "
@@ -204,6 +266,7 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
                    "the engine with telemetry active?")
 
     out.extend(_fleet_table(reqs, lost_alarms))
+    out.extend(_durability_section(records, reqs))
 
     if windows:
         out.append("")
@@ -263,7 +326,13 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
                      "serving/admission_deferrals", "serving/completed",
                      "serving/flood_injected", "serving/drained",
                      "serving/handoff_requests", "serving/handoff_bytes",
-                     "router/requeued", "router/shed", "router/replicas_lost"):
+                     "router/requeued", "router/shed", "router/replicas_lost",
+                     "serving/quarantined", "serving/poison_retries",
+                     "serving/degrade_climbs", "serving/degrade_cfg_disabled",
+                     "router/breaker_open", "router/breaker_closed",
+                     "router/hedged", "router/hedge_duplicates",
+                     "router/requeue_exhausted",
+                     "journal/accepted", "journal/duplicate_acks"):
             rec = (r.get("metrics") or {}).get(name)
             if rec and rec.get("total") is not None:
                 counters[name] = rec["total"]
