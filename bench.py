@@ -759,6 +759,67 @@ def run_bench() -> dict:
     except Exception as e:  # must never sink the bench
         serving_durability_row = {"error": str(e)[:200]}
 
+    # speculative decoding row (ISSUE 15): the fused sampler with and
+    # without the shallow-prefix drafter on a small dedicated geometry
+    # (CPU-safe — the row must land on every backend so the gate tracks it
+    # everywhere).  Greedy-exact by construction, so `parity` is a hard
+    # equality, and the honest numbers are accepted tokens per verify round
+    # (must beat 1.0 for a round to out-produce one sequential step) and
+    # end-to-end seconds/image against the k=0 baseline.
+    speculative_row = None
+    try:
+        import numpy as _np
+
+        from dalle_pytorch_tpu.models import dalle as _sdalle
+        from dalle_pytorch_tpu.models import speculative as _sspec
+        from dalle_pytorch_tpu.models.dalle import DALLEConfig as _SDCfg
+        from dalle_pytorch_tpu.models.sampling import (_prefill_phase,
+                                                       sample_image_codes)
+
+        s_cfg = _SDCfg(dim=128, depth=2, heads=4, dim_head=32,
+                       num_text_tokens=1000, text_seq_len=32,
+                       num_image_tokens=512, image_fmap_size=8)
+        s_params = _sdalle.init_dalle(jax.random.PRNGKey(21), s_cfg)
+        s_text = jax.random.randint(jax.random.PRNGKey(22),
+                                    (2, s_cfg.text_seq_len), 1,
+                                    s_cfg.num_text_tokens)
+        s_key = jax.random.PRNGKey(23)
+        spec_k, spec_d = 4, s_cfg.depth - 1  # deep drafter: acceptance lever
+
+        base = _np.asarray(sample_image_codes(s_params, s_cfg, s_text, s_key))
+        t0 = time.perf_counter()
+        _np.asarray(sample_image_codes(s_params, s_cfg, s_text, s_key))
+        base_s = (time.perf_counter() - t0) / s_text.shape[0]
+
+        @jax.jit
+        def spec_sample(p, t, k):
+            cache, last = _prefill_phase(p, s_cfg, t, None, 0, 1.0)
+            return _sspec.fused_spec_decode(
+                p, s_cfg, cache, last, k, 0.5, 1.0, 1.0, None, 0,
+                spec_k, spec_d, return_stats=True)
+
+        s_codes, s_stats = spec_sample(s_params, s_text, s_key)
+        s_codes = _np.asarray(s_codes)  # warm + parity pull
+        t0 = time.perf_counter()
+        s_codes2, s_stats = spec_sample(s_params, s_text, s_key)
+        _np.asarray(s_codes2)
+        spec_s = (time.perf_counter() - t0) / s_text.shape[0]
+        rounds = int(s_stats["spec_rounds"])
+        speculative_row = {
+            "parity": bool(_np.array_equal(base, s_codes)),
+            "spec_k": spec_k,
+            "draft_layers": spec_d,
+            "rounds": rounds,
+            # first code comes from prefill; every later token costs a round
+            "accepted_tokens_per_step": round(
+                (s_cfg.image_seq_len - 1) / max(rounds, 1), 3),
+            "seconds_per_image": round(spec_s, 4),
+            "baseline_seconds_per_image": round(base_s, 4),
+            "speedup": round(base_s / spec_s, 3) if spec_s > 0 else None,
+        }
+    except Exception as e:  # must never sink the bench
+        speculative_row = {"error": repr(e)[:200]}
+
     # flagship geometries (BASELINE.json config #4: "depth-64 1.3B"):
     # the true-1.3B geometry is the headline; the round-1/2 1.70B stand-in is
     # kept as a secondary row for cross-round continuity.  Each row runs as a
@@ -898,6 +959,7 @@ def run_bench() -> dict:
         "quantized_serving": quantized_serving_row,
         "quantized_parity": quantized_parity_row,
         "serving_durability": serving_durability_row,
+        "speculative": speculative_row,
         "sparse_attention": sparse_attention_row,
         "gen_seconds_per_image": round(gen_s_per_image, 3) if gen_s_per_image else None,
         "gen_full_pipeline_seconds_per_image": (
@@ -984,6 +1046,11 @@ GATE_SPECS = {
     # hedged/degraded p99 TTFT bounded — survival is the gated outcome
     "serving_durability.completion_rate": ("higher", 0.05),
     "serving_durability.ttft_p99_s": ("lower", 1.0),
+    # speculative decoding: accepted tokens per verify round must stay above
+    # 1.0 (a round that commits one token is pure draft overhead) and the
+    # end-to-end seconds/image must not fall off a cliff vs its own baseline
+    "speculative.accepted_tokens_per_step": ("higher", 0.5),
+    "speculative.seconds_per_image": ("lower", 0.5),
     "health_overhead.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),

@@ -57,6 +57,13 @@ def build_parser():
                         help="decode slots for --engine")
     parser.add_argument("--engine_block_size", type=int, default=64,
                         help="KV pool block size (tokens) for --engine")
+    parser.add_argument("--spec_k", type=int, default=0,
+                        help="self-speculative decoding: draft this many "
+                             "tokens per round through a shallow layer "
+                             "prefix, verify in one full pass (0 disables; "
+                             "greedy-exact, so images are bit-identical)")
+    parser.add_argument("--spec_draft_layers", type=int, default=None,
+                        help="draft-prefix depth (default depth // 2)")
     return parser
 
 
@@ -127,7 +134,9 @@ def main(argv=None):
             params, dalle_cfg, vae_params, vae_cfg,
             engine_cfg=EngineConfig(num_slots=args.engine_slots,
                                     block_size=args.engine_block_size,
-                                    filter_thres=args.top_k),
+                                    filter_thres=args.top_k,
+                                    spec_k=args.spec_k,
+                                    spec_draft_layers=args.spec_draft_layers),
         )
 
     paths = []
@@ -179,7 +188,8 @@ def _generate_all(args, params, dalle_cfg, vae_params, vae_cfg, tokenizer,
                 images = generate_images(
                     params, dalle_cfg, vae_params, vae_cfg, chunk, sk,
                     filter_thres=args.top_k, temperature=args.temperature,
-                    cond_scale=args.cond_scale,
+                    cond_scale=args.cond_scale, spec_k=args.spec_k,
+                    spec_draft_layers=args.spec_draft_layers,
                 )
             from PIL import Image
 

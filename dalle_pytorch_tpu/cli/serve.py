@@ -113,6 +113,14 @@ def build_parser():
                      help="run prefill on a separate worker pool and hand "
                           "the KV prefix to the decode replicas (priced as a "
                           "comms-ledger handoff row)")
+    eng.add_argument("--spec_k", type=int, default=0,
+                     help="self-speculative decoding: draft this many tokens "
+                          "per round through a shallow layer prefix, verify "
+                          "them in one full-model pass (0 disables — exactly "
+                          "today's sequential path)")
+    eng.add_argument("--spec_draft_layers", type=int, default=None,
+                     help="layers in the draft prefix (default depth // 2); "
+                          "must be in [1, depth)")
 
     slo = parser.add_argument_group("slo")
     slo.add_argument("--slo_ttft_p99", type=float, default=None,
@@ -269,6 +277,7 @@ def main(argv=None):
         headroom_frac=args.headroom_frac, filter_thres=args.top_k,
         telemetry_every=args.telemetry_every,
         quantize_kv=None if args.quantize_kv == "none" else args.quantize_kv,
+        spec_k=args.spec_k, spec_draft_layers=args.spec_draft_layers,
     )
     if args.replicas > 1 or args.disaggregate:
         from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
@@ -485,6 +494,13 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
     report["quarantined"] = obs_metrics.counter("serving/quarantined").value
     report["poison_retries"] = obs_metrics.counter(
         "serving/poison_retries").value
+    if args.spec_k:
+        rounds = obs_metrics.counter("serving/spec_rounds").value
+        accepted = obs_metrics.counter("serving/spec_accepted_tokens").value
+        report["spec_rounds"] = rounds
+        report["spec_accepted_tokens"] = accepted
+        report["spec_rejected_tokens"] = obs_metrics.counter(
+            "serving/spec_rejected_tokens").value
     if hasattr(engine, "router"):  # fleet: preemption + disaggregation ledger
         report["replicas"] = len(engine.engines)
         report["replicas_alive"] = len(engine.router.alive())

@@ -209,7 +209,8 @@ _decode_jit = partial(
 @partial(
     jax.jit,
     static_argnames=("cfg", "filter_thres", "cond_scale", "prime_len",
-                     "return_logit_stats"),
+                     "return_logit_stats", "spec_k", "spec_draft_layers",
+                     "spec_stochastic"),
 )
 def sample_image_codes(
     params: dict,
@@ -223,6 +224,9 @@ def sample_image_codes(
     prime_len: int = 0,
     noise_override: Optional[jnp.ndarray] = None,
     return_logit_stats: bool = False,
+    spec_k: int = 0,
+    spec_draft_layers: Optional[int] = None,
+    spec_stochastic: bool = False,
 ) -> jnp.ndarray:
     """text: (b, text_seq_len) raw token ids (0 = pad).  primer_codes:
     optional (b, prime_len) VAE codes to prime the image with.
@@ -231,7 +235,29 @@ def sample_image_codes(
     bit-exact comparison against other implementations (SURVEY.md §7 hard
     part #1).  Returns (b, image_seq_len) image codes (primer included);
     with return_logit_stats=True returns (codes, {"logit_max",
-    "entropy_mean"}) — sampling-distribution numerics for health telemetry."""
+    "entropy_mean"}) — sampling-distribution numerics for health telemetry.
+
+    spec_k > 0 turns on self-speculative decoding (models/speculative):
+    draft spec_k tokens through the first `spec_draft_layers` layers, verify
+    all of them in one full-model pass, accept the longest exact prefix.
+    The default match mode re-derives each position's token from the SAME
+    per-position step key the sequential scan would have used, so the output
+    is bit-identical to spec_k=0 at any temperature; spec_stochastic=True
+    swaps in standard rejection/residual sampling (same marginals, different
+    RNG stream).  spec_k=0 is exactly today's path — same jit graph."""
+    if spec_k > 0:
+        assert noise_override is None, "speculation owns the RNG stream"
+        assert not return_logit_stats, "logit stats live on the scan path"
+        from dalle_pytorch_tpu.models import speculative as spec_mod
+
+        cache, last_logits = _prefill_phase(
+            params, cfg, text, primer_codes, prime_len, cond_scale
+        )
+        return spec_mod.fused_spec_decode(
+            params, cfg, cache, last_logits, key, filter_thres, temperature,
+            cond_scale, primer_codes, prime_len, spec_k, spec_draft_layers,
+            stochastic=spec_stochastic,
+        )
     cache, last_logits = _prefill_phase(
         params, cfg, text, primer_codes, prime_len, cond_scale
     )
@@ -327,6 +353,8 @@ def generate_images(
     clip_params: Optional[dict] = None,
     clip_cfg=None,
     exec_cache: Optional[ExecutableCache] = None,
+    spec_k: int = 0,
+    spec_draft_layers: Optional[int] = None,
 ):
     """Full pipeline: sample codes, decode through the VAE (any family —
     DiscreteVAE / VQGAN / OpenAI dVAE, dispatched on the config type),
@@ -358,6 +386,37 @@ def generate_images(
     b = int(text.shape[0])
     n_gen = cfg.image_seq_len - prime_len
     tele = telemetry.active()
+    if spec_k > 0:
+        # speculative sampling is one fused jit (draft + verify rounds in a
+        # while_loop) — the AOT exec-cache and the phase-split telemetry jits
+        # don't carry it, so both are bypassed here; wall-clock still lands
+        # in the decode histogram (prefill is fused into the same dispatch)
+        import contextlib
+
+        suspend = (tele.compile_watcher.suspended()
+                   if tele is not None and tele.compile_watcher is not None
+                   else contextlib.nullcontext())
+        with suspend:
+            t0 = time.perf_counter()
+            codes = sample_image_codes(
+                params, cfg, text, key,
+                filter_thres=filter_thres, temperature=temperature,
+                cond_scale=cond_scale, primer_codes=primer,
+                prime_len=prime_len, spec_k=spec_k,
+                spec_draft_layers=spec_draft_layers,
+            )
+            jax.block_until_ready(codes)
+            decode_s = time.perf_counter() - t0
+        if tele is not None:
+            obs_metrics.histogram("gen/decode_s").observe(decode_s)
+            obs_metrics.counter("gen/images").inc(b)
+            obs_metrics.counter("gen/image_tokens").inc(b * n_gen)
+            obs_metrics.gauge("gen/image_tokens_per_sec").set(
+                b * n_gen / max(decode_s, 1e-9)
+            )
+        return _finish_generate(
+            vae_params, vae_cfg, text, codes, clip_params, clip_cfg,
+        )
     if exec_cache is not None:
         import contextlib
 

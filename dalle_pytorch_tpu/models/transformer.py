@@ -720,7 +720,9 @@ def _assert_scannable(cfg, specs):
         "mask per layer, and per-head layouts would multiply that memory by "
         "`heads` for every layer — use the unrolled sequential/remat engines"
     )
-    assert len({s.attn_id for s in specs}) == cfg.depth and len({s.ff_id for s in specs}) == cfg.depth, (
+    # compared against len(specs), not cfg.depth: the speculative draft/verify
+    # passes scan a contiguous SLICE of the stack
+    assert len({s.attn_id for s in specs}) == len(specs) and len({s.ff_id for s in specs}) == len(specs), (
         "scan_layers requires unshared layers (shared_attn_ids/shared_ff_ids unset)"
     )
 
@@ -1199,25 +1201,64 @@ def _run_cached_scan(params, cfg, specs, x, cache, mode, rotary, key_mask=None,
     return jax.lax.scan(body, x, (stacked, midx, cache["layers"]))
 
 
+def _resolve_layer_range(cfg, specs, layer_start, layer_stop):
+    """Validate a [layer_start, layer_stop) slice of the stack (speculative
+    drafting runs layers [0, d) then verification continues [d, depth)).
+    Returns (sliced_specs, partial: bool).  Reversible execution interleaves
+    the two residual streams across the whole stack, so a partial run has no
+    well-defined hidden state to hand off — refuse it."""
+    n = len(specs)
+    stop = n if layer_stop is None else layer_stop
+    if not (0 <= layer_start < stop <= n):
+        raise ValueError(
+            f"layer range [{layer_start}, {stop}) invalid for depth {n}")
+    partial = layer_start != 0 or stop != n
+    if partial and cfg.execution == "reversible":
+        raise ValueError(
+            "partial layer ranges (speculative drafting) require sequential "
+            "execution; reversible twin-stream layers cannot be split")
+    return specs[layer_start:stop], partial
+
+
 def decode_step(
     params: dict,
     cfg: TransformerConfig,
     x: jnp.ndarray,
     cache: dict,
     text_only: bool = False,
+    layer_start: int = 0,
+    layer_stop: int = None,
 ) -> Tuple[jnp.ndarray, dict]:
     """Process ONE token (b, 1, dim) at position cache['offset'].  Sampling
     runs with dropout disabled (eval mode), matching the reference's
     eval_decorator.  text_only: the decode position is in the text region
-    (generate_texts) — the token shift is skipped (identity there)."""
+    (generate_texts) — the token shift is skipped (identity there).
+
+    layer_start/layer_stop run only layers [layer_start, layer_stop) — the
+    speculative drafter's shallow prefix (layer_stop=d) and the verifier's
+    continuation from a stored layer-d hidden (layer_start=d).  The returned
+    cache keeps the untouched layers' entries verbatim, so a draft pass
+    followed by a verify pass writes exactly what one full pass would."""
     specs = derive_layer_specs(cfg)
+    specs, partial = _resolve_layer_range(cfg, specs, layer_start, layer_stop)
     rotary = transformer_rotary(cfg)
     offset = cache["offset"]
 
     if cfg.scan_layers:
+        run_cache = cache
+        if partial:
+            run_cache = dict(cache, layers=jax.tree_util.tree_map(
+                lambda a: a[layer_start:layer_start + len(specs)],
+                cache["layers"]))
         out, new_layers = _run_cached_scan(
-            params, cfg, specs, x, cache, "decode", rotary, text_only=text_only
+            params, cfg, specs, x, run_cache, "decode", rotary,
+            text_only=text_only
         )
+        if partial:
+            new_layers = jax.tree_util.tree_map(
+                lambda full, part:
+                full.at[layer_start:layer_start + len(specs)].set(part),
+                cache["layers"], new_layers)
         return out, {"offset": offset + 1, "layers": new_layers}
 
     patterns = spec_patterns(cfg, specs)
@@ -1233,6 +1274,11 @@ def decode_step(
         )
 
     out, new_layers = _run_cached_layers(cfg, specs, x, cache, branch)
+    if partial:
+        merged = list(cache["layers"])
+        for spec, lc in zip(specs, new_layers):
+            merged[spec.index] = lc
+        new_layers = merged
     return out, {"offset": offset + 1, "layers": new_layers}
 
 
@@ -1562,13 +1608,20 @@ def paged_decode_step(
     offsets: jnp.ndarray,
     rings: Optional[dict],
     block_size: int,
+    layer_start: int = 0,
+    layer_stop: int = None,
 ) -> Tuple[jnp.ndarray, dict, Optional[dict]]:
     """One decode step for a whole SLOT BATCH of independent sequences at
     per-slot positions.  x: (S, 1, dim) embedded tokens; `offsets`: (S,)
     per-slot cache offsets (the position each slot's token occupies);
     `rings`: init_slot_rings state or None.  Returns (out (S, 1, dim),
-    new pool, new rings).  The serving engine's fused per-iteration decode."""
+    new pool, new rings).  The serving engine's fused per-iteration decode.
+
+    layer_start/layer_stop restrict the pass to layers [layer_start,
+    layer_stop) — the speculative draft (prefix) and verify (continuation)
+    halves.  The returned pool/rings keep untouched layers' state verbatim."""
     specs = derive_layer_specs(cfg)
+    specs, partial = _resolve_layer_range(cfg, specs, layer_start, layer_stop)
     rotary = transformer_rotary(cfg)
     assert block_tables.shape[1] * block_size >= cfg.seq_len, (
         "block tables must cover a full sequence: "
@@ -1576,10 +1629,27 @@ def paged_decode_step(
     )
 
     if cfg.scan_layers:
-        return _paged_decode_scan(
-            params, cfg, specs, x, pool, block_tables, offsets, rings,
+        run_pool, run_rings = pool, rings
+        if partial:
+            sl = slice(layer_start, layer_start + len(specs))
+            run_pool = {"layers": jax.tree_util.tree_map(
+                lambda a: a[sl], pool["layers"])}
+            if rings is not None:
+                run_rings = {"layers": jax.tree_util.tree_map(
+                    lambda a: a[sl], rings["layers"])}
+        out, new_pool, new_rings = _paged_decode_scan(
+            params, cfg, specs, x, run_pool, block_tables, offsets, run_rings,
             block_size, rotary,
         )
+        if partial:
+            new_pool = {"layers": jax.tree_util.tree_map(
+                lambda full, part: full.at[sl].set(part),
+                pool["layers"], new_pool["layers"])}
+            if rings is not None:
+                new_rings = {"layers": jax.tree_util.tree_map(
+                    lambda full, part: full.at[sl].set(part),
+                    rings["layers"], new_rings["layers"])}
+        return out, new_pool, new_rings
 
     patterns = spec_patterns(cfg, specs)
     dec_tabs = _decode_tables_by_key(cfg, patterns)
@@ -1635,6 +1705,16 @@ def paged_decode_step(
                 new_ring_layers.append(new_ring)
         out = h
 
+    if partial:
+        merged_pool = list(pool["layers"])
+        for spec, lp in zip(specs, new_pool_layers):
+            merged_pool[spec.index] = lp
+        new_pool_layers = merged_pool
+        if cfg.shift_tokens:
+            merged_rings = list(rings["layers"])
+            for spec, rl in zip(specs, new_ring_layers):
+                merged_rings[spec.index] = rl
+            new_ring_layers = merged_rings
     new_rings = {"layers": new_ring_layers} if cfg.shift_tokens else None
     return out, {"layers": new_pool_layers}, new_rings
 

@@ -11,8 +11,11 @@ of jumping straight to shedding:
                            guidance)
   rung 2  cap_candidates — new admissions decode with the capped top-k
                            candidate set (EngineConfig.degraded_filter_thres;
-                           the per-lane `cand_cap` mask in the decode jit)
-                           (quality traded: sampling diversity)
+                           the per-lane `cand_cap` mask in the decode jit),
+                           and speculative decoding is suppressed (k=0 —
+                           `suppress_spec`) so draft passes never compete
+                           with admission (quality traded: sampling
+                           diversity; latency traded: step count)
   rung 3  short_prompts  — admit only prompts with at most
                            `short_prompt_max` non-pad tokens; long prompts
                            are refused (kind `degraded_long_prompt`)
@@ -82,6 +85,17 @@ class DegradeLadder:
     @property
     def rung_name(self) -> str:
         return RUNGS[self.rung]
+
+    @property
+    def suppress_spec(self) -> bool:
+        """True from `cap_candidates` up: the same rung that caps the
+        candidate set also sets speculative k=0, so drafting (which costs a
+        full extra shallow pass per round) never competes with admission
+        during load-shed.  The engine checks this per poll and falls back to
+        the sequential decode jit — the rung descending re-enables
+        speculation with no state to migrate, since the sequential and
+        speculative paths share the same lane state."""
+        return self.rung >= 2
 
     # ---------------------------------------------------------- observation
     @staticmethod

@@ -50,6 +50,7 @@ import numpy as np
 
 from dalle_pytorch_tpu.models import dalle as dalle_mod
 from dalle_pytorch_tpu.models import sampling as sampling_mod
+from dalle_pytorch_tpu.models import speculative as spec_mod
 from dalle_pytorch_tpu.models.transformer import (
     init_slot_rings,
     paged_decode_step,
@@ -58,7 +59,6 @@ from dalle_pytorch_tpu.models.transformer import (
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.ops.sampling import gumbel_sample, top_k_filter
-from dalle_pytorch_tpu.ops.stable import divide_max
 from dalle_pytorch_tpu.serving.kv_pool import BlockPool
 from dalle_pytorch_tpu.serving.scheduler import (
     AdmissionController,
@@ -87,6 +87,10 @@ class EngineConfig:
     #                              quarantined with a terminal `poisoned` record
     degraded_filter_thres: float = 0.98  # top-k keep fraction for lanes
     #                              admitted under the cap-candidates rung
+    spec_k: int = 0  # speculative decode: tokens drafted per round (0 = off,
+    #                  the sequential path — same jit, same bits as before)
+    spec_draft_layers: Optional[int] = None  # drafter depth d (layers [0, d)),
+    #                  default depth // 2; the verify pass runs [d, depth)
 
 
 class GenerationEngine:
@@ -194,6 +198,36 @@ class GenerationEngine:
         self._win_decode_steps = 0
         self._win_lane_tokens = 0
         self._win_t = time.monotonic()
+        # speculative decode state: (k, d) when enabled, the draft/verify
+        # jit pair (NO donation — verify needs the pre-round rings for its
+        # rollback while the draft result is still live), warm-compile flag,
+        # and the per-window accounting behind spec/accepted_tokens_per_step
+        # and spec/draft_time_frac
+        self._spec: Optional[tuple] = None
+        self._warm_spec = False
+        self._win_spec_rounds = 0
+        self._win_spec_accepted = 0
+        self._win_spec_draft_s = 0.0
+        self._win_spec_total_s = 0.0
+        if engine_cfg.spec_k:
+            self._spec = spec_mod.validate_spec(
+                self.tcfg, engine_cfg.spec_k, engine_cfg.spec_draft_layers)
+            k, d = self._spec
+            self._spec_draft_fn = jax.jit(
+                lambda params, state: spec_mod.engine_spec_draft(
+                    params, self.cfg, self.tcfg, state, spec_k=k,
+                    draft_layers=d, block_size=engine_cfg.block_size,
+                    filter_thres=engine_cfg.filter_thres,
+                    degraded_filter_thres=engine_cfg.degraded_filter_thres,
+                ))
+            self._spec_verify_fn = jax.jit(
+                lambda params, state, draft: spec_mod.engine_spec_verify(
+                    params, self.cfg, self.tcfg, state, draft, spec_k=k,
+                    draft_layers=d, block_size=engine_cfg.block_size,
+                    n_gen=self.n_gen,
+                    filter_thres=engine_cfg.filter_thres,
+                    degraded_filter_thres=engine_cfg.degraded_filter_thres,
+                ))
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_fn = jax.jit(self._decode_step_impl, donate_argnums=donate)
@@ -208,9 +242,13 @@ class GenerationEngine:
 
     # ------------------------------------------------------------------ jits
     def _decode_step_impl(self, params, state):
-        """One fused decode step for all slots."""
+        """One fused decode step for all slots.  The transformer output ->
+        sampled code half (masked logits, poison injection, CFG across lane
+        pairs, nonfinite screen, degrade-capped top-k, per-lane step key,
+        feed-source mirror) lives in `speculative.lane_sample_pipeline`, the
+        single pipeline the speculative draft/verify round also runs — so
+        the two decode modes cannot drift apart bit-wise."""
         cfg, tcfg = self.cfg, self.tcfg
-        S = self.ecfg.num_slots
         prev = state["prev_code"]
 
         emb = jnp.take(dalle_mod._image_table(params, cfg), prev[:, None],
@@ -225,71 +263,16 @@ class GenerationEngine:
             self.ecfg.block_size,
         )
 
-        # per-slot _logits_at: row = producing position = pre-increment offset
-        if cfg.stable:
-            out = divide_max(out)
-        logits = dalle_mod.to_logits(params, cfg, out)[:, 0]  # (S, V)
-        rows = jnp.take(
-            dalle_mod.logits_mask_slice(cfg, cfg.total_seq_len),
-            state["offsets"], axis=0, mode="clip",
+        # per-slot _logits_at row = producing position = pre-increment offset;
+        # per-lane step key row = img_prev (the index of the token being made)
+        code, bad = spec_mod.lane_sample_pipeline(
+            params, cfg, out, state["offsets"], state["img_prev"], state,
+            self.ecfg.filter_thres, self.ecfg.degraded_filter_thres,
         )
-        logits = jnp.where(rows, jnp.finfo(logits.dtype).min, logits)
-
-        # poison-request fault: NaN one lane's raw logits inside the jit.
-        # The injection is a per-lane jnp.where, so every OTHER lane's row is
-        # bit-identical to an uninjected run (the quarantine drill's
-        # cohabitation pin).
-        inject = jnp.arange(S, dtype=jnp.int32) == state["poison_lane"]
-        logits = jnp.where(inject[:, None],
-                           jnp.asarray(jnp.nan, logits.dtype), logits)
-
-        # classifier-free guidance across lane pairs (solo lanes pass through)
-        null_lg = jnp.take(logits, state["partner"], axis=0)
-        lg = jnp.where(
-            state["guided"][:, None],
-            null_lg + (logits - null_lg) * state["cscale"][:, None].astype(logits.dtype),
-            logits,
-        )
-
-        # jit-pure per-lane nonfinite screen (the resilience.nonfinite_guard
-        # discipline): flag a bad row into state["poisoned"] — the host pulls
-        # the flag ONLY at the existing eviction sync — and sanitize it so
-        # sampling stays defined without touching healthy rows bit-wise.
-        # Post-CFG so a NaN in either lane of a guided pair flags both.
-        bad = ~jnp.isfinite(lg).all(axis=-1) & state["active"]
         poisoned = state["poisoned"] | bad
-        lg = jnp.where(bad[:, None], jnp.zeros_like(lg), lg)
-
-        # top-k candidate filter with the degrade ladder's per-lane cap: one
-        # lax.top_k (exactly top_k_filter's graph), then capped lanes keep
-        # only the first k_cap sorted columns.  With cand_cap all-False the
-        # kept set — and the scatter — is bit-identical to top_k_filter.
-        V = lg.shape[-1]
-        k = max(int((1.0 - self.ecfg.filter_thres) * V), 1)
-        k_cap = min(max(int((1.0 - self.ecfg.degraded_filter_thres) * V), 1), k)
-        val, ind = jax.lax.top_k(lg, k)
-        keep = jnp.where(state["cand_cap"][:, None],
-                         jnp.arange(k) < k_cap, True)
-        val = jnp.where(keep, val, -jnp.inf)
-        filtered = jnp.put_along_axis(
-            jnp.full_like(lg, -jnp.inf), ind, val, axis=-1, inplace=False)
-        keys_t = jnp.take_along_axis(
-            state["keys"],
-            jnp.clip(state["img_prev"], 0, state["keys"].shape[1] - 1)[:, None, None],
-            axis=1,
-        )[:, 0]
-
-        def sample_one(lg_row, k, t):
-            # (1, V) shapes mirror the fused sampler's batch-1 call exactly
-            return gumbel_sample(k, lg_row[None], temperature=t)[0]
-
-        toks = jax.vmap(sample_one)(filtered, keys_t, state["temp"].astype(logits.dtype))
-        code = jnp.clip(
-            toks - cfg.num_text_tokens_padded, 0, cfg.num_image_tokens - 1
-        ).astype(jnp.int32)
-        code = jnp.take(code, state["feed_src"], axis=0)  # null lanes feed cond's code
 
         act = state["active"]
+        S = self.ecfg.num_slots
         img_new = jnp.where(act, state["img_prev"] + 1, state["img_prev"])
         widx = jnp.clip(img_new, 0, self.n_gen - 1)
         existing = jnp.take_along_axis(state["codes"], widx[:, None], axis=1)[:, 0]
@@ -752,6 +735,9 @@ class GenerationEngine:
             extra.setdefault("hedged", True)
         if req.replayed:
             extra.setdefault("replayed", True)
+        if req.spec_rounds > 0:
+            extra.setdefault("accepted_tokens_per_step",
+                             round(req.accepted_tokens_per_step, 4))
         tele.spans.write_event(
             "request", request_id=req.id, outcome=outcome,
             guided=req.guided, synthetic=req.synthetic,
@@ -891,6 +877,10 @@ class GenerationEngine:
         obs_metrics.gauge("serving/pool_free_blocks").set(self.pool.free_blocks)
 
     def _decode_once(self) -> None:
+        if self._spec is not None and not (
+                self.degrade is not None and self.degrade.suppress_spec):
+            self._spec_decode_once()
+            return
         with (self._suspend_compiles() if not self._warm_decode
               else contextlib.nullcontext()):
             self._state = self._decode_fn(self.params, self._state)
@@ -905,6 +895,60 @@ class GenerationEngine:
                     and req.codes_done % self.journal.progress_every == 0):
                 # host-held counter only — journaling progress adds no sync
                 self.journal.progress(req)
+
+    def _spec_decode_once(self) -> None:
+        """One speculative round: draft k tokens through the shallow prefix,
+        verify them all in one full-model dispatch, advance each lane by its
+        accepted length.  The per-round host pull of the accepted-length
+        vector is the price of per-request progress bookkeeping (eviction,
+        journal progress, drain exactness) — the honest overhead the README
+        documents; the sequential path keeps its zero-extra-sync property."""
+        k = self._spec[0]
+        t0 = time.perf_counter()
+        with (self._suspend_compiles() if not self._warm_spec
+              else contextlib.nullcontext()):
+            draft = self._spec_draft_fn(self.params, self._state)
+            # draft/verify wall attribution needs the boundary to exist
+            jax.block_until_ready(draft["drafts"])  # host-sync-ok: spec/draft_time_frac attribution point
+            t1 = time.perf_counter()
+            self._state, acc = self._spec_verify_fn(
+                self.params, self._state, draft)
+            acc_np = np.asarray(acc)  # host-sync-ok: accepted lengths drive codes_done/eviction
+        t2 = time.perf_counter()
+        self._warm_spec = True
+        accepted = 0
+        lane_tokens = 0
+        for req in self._inflight:
+            adv = int(acc_np[req.lanes[0]])  # host-sync-ok: acceptance bookkeeping on the already-pulled np vector
+            old_done = req.codes_done
+            req.codes_done += adv
+            req.spec_rounds += 1
+            accepted += adv
+            lane_tokens += adv * len(req.lanes)
+            # host free-list commit point: the reservation keeps its blocks,
+            # the ledger's live-token count snaps back to the verified prefix
+            for i in range(len(req.lanes)):
+                self.pool.truncate_slot((req.id << 1) | i,
+                                        self.n_pre + req.codes_done - 1)
+            if (self.journal is not None and adv
+                    and (old_done // self.journal.progress_every
+                         != req.codes_done // self.journal.progress_every)):
+                # same cadence as the sequential path's % check, generalized
+                # to multi-token advances: fire on every boundary crossing
+                self.journal.progress(req)
+        obs_metrics.counter("serving/decode_steps").inc()
+        obs_metrics.counter("serving/decode_lane_tokens").inc(lane_tokens)
+        obs_metrics.counter("serving/spec_rounds").inc()
+        obs_metrics.counter("serving/spec_accepted_tokens").inc(accepted)
+        obs_metrics.counter("serving/spec_rejected_tokens").inc(
+            max((k + 1) * len(self._inflight) - accepted, 0))
+        self._win_decode_steps += 1
+        self._win_lane_tokens += lane_tokens
+        # request-rounds, so the window gauge is mean accepted/step/request
+        self._win_spec_rounds += len(self._inflight)
+        self._win_spec_accepted += accepted
+        self._win_spec_draft_s += t1 - t0
+        self._win_spec_total_s += t2 - t0
 
     def _evict_finished(self) -> List[Request]:
         done = [r for r in self._inflight if r.codes_done >= self.n_gen]
@@ -1011,12 +1055,30 @@ class GenerationEngine:
         if goodput is not None:
             obs_metrics.gauge("serving/goodput_frac").set(goodput)
         obs_metrics.gauge("serving/lane_tokens_per_s").set(lane_tokens / elapsed)
+        spec_accept = None
+        spec_draft_frac = None
+        if self._win_spec_rounds:
+            spec_accept = self._win_spec_accepted / self._win_spec_rounds
+            obs_metrics.gauge("spec/accepted_tokens_per_step").set(spec_accept)
+            if self._win_spec_total_s > 0:
+                spec_draft_frac = self._win_spec_draft_s / self._win_spec_total_s
+                obs_metrics.gauge("spec/draft_time_frac").set(spec_draft_frac)
         self._phase_acc = {k: 0.0 for k in self._phase_acc}
         self._win_decode_steps = 0
         self._win_lane_tokens = 0
+        self._win_spec_rounds = 0
+        self._win_spec_accepted = 0
+        self._win_spec_draft_s = 0.0
+        self._win_spec_total_s = 0.0
         self._win_t = now
         tele = telemetry.active()
         if tele is not None:
+            spec_fields = {}
+            if spec_accept is not None:
+                spec_fields["spec_accepted_tokens_per_step"] = round(
+                    spec_accept, 4)
+            if spec_draft_frac is not None:
+                spec_fields["spec_draft_time_frac"] = round(spec_draft_frac, 4)
             tele.spans.write_event(
                 "serving_window", iter=self._iter,
                 queue_depth=len(self.queue),
@@ -1026,6 +1088,7 @@ class GenerationEngine:
                 phase_s=phases, goodput_frac=goodput,
                 lane_tokens_per_s=lane_tokens / elapsed,
                 decode_steps=steps,
+                **spec_fields,
                 **self.quantization_state(),
             )
         if self._slo is not None:

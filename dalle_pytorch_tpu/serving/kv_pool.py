@@ -155,6 +155,26 @@ class BlockPool:
             self._free.extend(blocks)
             self.publish_gauges()
 
+    def truncate_slot(self, owner: int, n: int) -> int:
+        """Roll `owner`'s sequence back to `n` valid tokens (speculative
+        decode rejected everything past position n-1).  Allocation here is
+        whole-sequence reservation — the blocks stay owned for the rest of
+        the sequence the request WILL still generate — so rollback frees
+        ZERO blocks; this is the host-side commit point that keeps the
+        ledger's notion of live tokens consistent with the device offsets
+        and re-publishes the gauges.  Returns the number of blocks holding
+        live tokens (the device side needs no touch-up: rejected KV columns
+        are masked out of every read and overwritten before reuse)."""
+        blocks = self._owned.get(owner)
+        if blocks is None:
+            raise KeyError(f"truncate_slot: owner {owner} holds no blocks")
+        if not (0 <= n <= self.blocks_per_seq * self.block_size):
+            raise ValueError(
+                f"truncate_slot: n={n} outside [0, "
+                f"{self.blocks_per_seq * self.block_size}]")
+        self.publish_gauges()
+        return -(-n // self.block_size)
+
     def owners(self) -> List[int]:
         return list(self._owned)
 

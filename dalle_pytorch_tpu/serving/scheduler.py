@@ -95,6 +95,9 @@ class Request:
     phases: Dict[str, float] = dataclasses.field(default_factory=dict)
     deferrals: int = 0
     outcome: Optional[str] = None
+    # speculative decode: verify rounds this request sat through (0 when the
+    # engine ran the sequential path)
+    spec_rounds: int = 0
     # results
     codes: Optional[np.ndarray] = None
     images: Optional[np.ndarray] = None
@@ -106,6 +109,17 @@ class Request:
     @property
     def lanes_needed(self) -> int:
         return 2 if self.guided else 1
+
+    @property
+    def accepted_tokens_per_step(self) -> Optional[float]:
+        """Mean tokens committed per speculative round for THIS request —
+        the per-request acceptance-rate number the telemetry record and the
+        bench percentiles report.  None when the request never ran under
+        speculation.  `codes_done - 1` because the first code comes from
+        prefill, not a decode round."""
+        if self.spec_rounds <= 0:
+            return None
+        return (self.codes_done - 1) / self.spec_rounds
 
     @property
     def deadline_t(self) -> Optional[float]:

@@ -161,6 +161,10 @@ def test_train_dalle_resume(workspace, trained_dalle):
     assert rates and all(r > 0 for r in rates)
 
 
+@pytest.mark.slow  # tier-1 budget: the pieces stay fast via
+#                    test_resharding's orbax validate/roundtrip tests and the
+#                    npz train-resume CLI legs; this is the three-subprocess
+#                    orbax end-to-end stitch
 def test_sharded_checkpoint_train_resume_generate(workspace, trained_vae):
     """--sharded_checkpoint end to end: orbax directory save (no host
     gather), resume from the directory (weights restored after distribution),

@@ -79,7 +79,11 @@ def test_ring_attention_non_causal():
     np.testing.assert_allclose(got, want, atol=2e-5)
 
 
-@pytest.mark.parametrize("zero_stage", [0, 1, 3])
+# tier-1 budget: z1 is slow-marked — the mechanism sweep stays fast via the
+# z0 / z3 extremes (z1 differs only in optimizer-state partitioning, which
+# z3 exercises a superset of)
+@pytest.mark.parametrize("zero_stage",
+                         [0, pytest.param(1, marks=pytest.mark.slow), 3])
 def test_sharded_training_matches_single_device(zero_stage):
     """The same params + batch must produce the same loss trajectory on an
     8-way mesh (any ZeRO stage) as on a single device."""

@@ -538,6 +538,12 @@ _DUMMY = ["--dummy_run", "8", "--telemetry", "off", "--log_every_n_steps",
           "1", "--batch_size", "8"]
 
 
+@pytest.mark.slow  # tier-1 budget: the mechanisms stay fast via
+#                    test_reshard_round_trip_bit_identical (the reshard math),
+#                    test_validate_raises_reshard_required_on_topology_change
+#                    (detection), and test_auto_resume_does_not_skip_
+#                    reshardable_checkpoints (selection); this leg is the
+#                    two-subprocess end-to-end stitch
 def test_shrink_at_step_n_and_resume_on_fewer_devices(tmp_path):
     """THE acceptance proof: `--inject_fault shrink@4` SIGKILLs a dp8 run;
     `--resume auto` on FOUR devices detects the topology change

@@ -274,6 +274,14 @@ def _losses(metrics_jsonl):
 _DUMMY = ["--dummy_run", "8", "--telemetry", "off", "--log_every_n_steps", "1"]
 
 
+@pytest.mark.slow  # tier-1 budget: the mechanisms stay fast via
+#                    test_preempt_writes_emergency_checkpoint_and_exit_75
+#                    (emergency write + exit codes),
+#                    test_resume_auto_falls_back_past_corrupt_and_truncated
+#                    (resume selection), and
+#                    test_rollback_recovers_from_transient_divergence
+#                    (exact state restore); this leg is the two-subprocess
+#                    end-to-end stitch
 def test_kill_at_step_n_and_resume_matches_uninterrupted(tmp_path):
     """THE acceptance proof: SIGKILL mid-run, `--resume auto`, and the
     stitched loss trajectory equals an uninterrupted run batch-for-batch

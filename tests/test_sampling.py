@@ -53,14 +53,22 @@ def greedy_oracle(params, cfg, text):
     "kw",
     [
         dict(),
-        dict(attn_types=("axial_row", "conv_like")),
-        dict(execution="reversible"),
+        # tier-1 budget: the sparse / reversible / scan legs are
+        # slow-marked — attention variants stay fast via test_transformer's
+        # per-mechanism parity tests and the sampling oracle stays fast via
+        # the base + asymmetric-geometry params
+        pytest.param(dict(attn_types=("axial_row", "conv_like")),
+                     marks=pytest.mark.slow),
+        pytest.param(dict(execution="reversible"), marks=pytest.mark.slow),
         # asymmetric geometry: the logits-mask row is selected by the
         # PRODUCING position (dalle_pytorch.py:646-652); a text/image length
         # imbalance catches off-by-one row selection the square case hides
         dict(text_seq_len=12, image_fmap_size=3, num_image_tokens=24),
         # scan-layers cached decode: stacked caches + traced mask select
-        dict(scan_layers=True, attn_types=("full", "axial_row", "conv_like")),
+        pytest.param(
+            dict(scan_layers=True,
+                 attn_types=("full", "axial_row", "conv_like")),
+            marks=pytest.mark.slow),
     ],
 )
 def test_greedy_sampling_matches_uncached_oracle(kw):
@@ -296,6 +304,9 @@ def test_greedy_sampling_flash_prefill_matches_oracle():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # tier-1 budget: flash prefill stays fast via
+#                    test_greedy_sampling_flash_prefill_matches_oracle; this
+#                    leg adds the scan-layers stacked-liveness-table variant
 def test_greedy_sampling_flash_prefill_scan_layers_matches_oracle():
     """scan_layers + flash prefill: the traced per-layer mask comes with a
     stacked tile-liveness table (dead pattern tiles stay skipped in the

@@ -127,6 +127,9 @@ def test_paged_parity_config_matrix(kw, sample_kw):
         req.codes[None], fused_ref(params, cfg, text[0], key, **sample_kw))
 
 
+@pytest.mark.slow  # tier-1 budget: paged parity stays fast via the
+#                    staggered/guided/scan/config-matrix legs above; this leg
+#                    adds the bf16 weak-temperature dtype variant
 def test_paged_parity_bf16_weak_temperature(base):
     """Deployment-dtype serving: bf16 params, non-trivial temperature.  The
     engine's per-lane temperature vector must behave like the fused path's

@@ -283,6 +283,9 @@ def test_scan_layers_matches_loop_with_per_layer_sparse():
     np.testing.assert_allclose(a, b, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: remat-vs-sequential parity stays fast via
+#                    test_remat_matches_sequential; this leg sweeps the
+#                    selective checkpoint policies
 def test_remat_policies_match_sequential():
     """Selective remat policies are pure memory/schedule choices — outputs and
     grads must match the sequential engine exactly."""

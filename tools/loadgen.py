@@ -91,11 +91,26 @@ class PoissonLoadGen:
             r.phases["queue_wait"] for r in completed
             if getattr(r, "phases", None) and "queue_wait" in r.phases
         ])
+        # speculative decode: per-request acceptance rate from the SAME
+        # completed-Request stream the TTFT/latency percentiles read — the
+        # bench row's accepted-tokens/step is a percentile over these, not a
+        # separately-sampled gauge
+        accepts = np.asarray([
+            r.accepted_tokens_per_step for r in completed
+            if getattr(r, "accepted_tokens_per_step", None) is not None
+        ])
 
         def pct(a, q):
             return float(np.percentile(a, q)) if a.size else None
 
         n = len(completed)
+        spec = {}
+        if accepts.size:
+            spec = {
+                "accepted_tokens_per_step_p50": pct(accepts, 50),
+                "accepted_tokens_per_step_mean": float(accepts.mean()),
+                "accepted_tokens_per_step_min": float(accepts.min()),
+            }
         return {
             "requests_completed": n,
             "requests_refused": refused,
@@ -109,6 +124,7 @@ class PoissonLoadGen:
             "latency_p99_s": pct(lats, 99),
             # the engine runs on ONE device; normalize per serving chip
             "images_per_sec_per_chip": (n / elapsed_s if elapsed_s > 0 else None),
+            **spec,
         }
 
 

@@ -23,6 +23,9 @@ Sections, all from the stream serving/engine.py writes:
   storage dtypes plus the analytic dequant overhead: extra flops per decode
   step and their fraction of the step's matmul work — per-request overhead
   is that fraction times the decode share from the phase table;
+* **speculation** — when speculative decoding ran (`--spec_k`), the
+  per-request acceptance rate (from request records) and the draft/verify
+  wall-clock split (from the windows' `spec_draft_time_frac`);
 * **SLO windows** (`kind:"slo_window"`) + burn-rate / backpressure alarms
   and the refusal/deferral counters from metric snapshots;
 * **fleet** — when request records carry a `replica` tag (serving/fleet.py
@@ -162,6 +165,42 @@ def _quant_section(windows: List[Dict[str, Any]],
     return out
 
 
+def _spec_section(windows: List[Dict[str, Any]],
+                  done: List[Dict[str, Any]]) -> List[str]:
+    """Speculative decoding: per-request acceptance rate (from the request
+    records' `accepted_tokens_per_step` field) and the draft/verify phase
+    attribution (from the serving_window spec fields)."""
+    accepts = [r["accepted_tokens_per_step"] for r in done
+               if r.get("accepted_tokens_per_step") is not None]
+    sw = [w for w in windows
+          if w.get("spec_accepted_tokens_per_step") is not None]
+    if not accepts and not sw:
+        return []
+    out = ["", "speculation:"]
+    if accepts:
+        out.append(f"  per-request accepted tokens/step: "
+                   f"mean {sum(accepts) / len(accepts):.2f}  "
+                   f"p50 {_pct(accepts, 0.50):.2f}  "
+                   f"min {min(accepts):.2f}  "
+                   f"({len(accepts)} speculative request(s))")
+        if sum(accepts) / len(accepts) <= 1.0:
+            out.append("  note: mean acceptance <= 1 token/step — the draft "
+                       "passes are pure overhead at this acceptance rate; "
+                       "lower --spec_k or raise --spec_draft_layers")
+    if sw:
+        wacc = [w["spec_accepted_tokens_per_step"] for w in sw]
+        out.append(f"  window accepted tokens/step:      "
+                   f"mean {sum(wacc) / len(wacc):.2f} over {len(sw)} window(s)")
+        fracs = [w["spec_draft_time_frac"] for w in sw
+                 if w.get("spec_draft_time_frac") is not None]
+        if fracs:
+            mean_frac = sum(fracs) / len(fracs)
+            out.append(f"  draft/verify attribution:         "
+                       f"{mean_frac * 100:.0f}% of round wall in the draft "
+                       f"pass, {(1 - mean_frac) * 100:.0f}% in verify")
+    return out
+
+
 RUNG_NAMES = ("normal", "no_cfg", "cap_candidates", "short_prompts", "shed")
 
 
@@ -287,6 +326,7 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
                 f"{f'{g * 100:.0f}%' if g is not None else '-':>8}  {split}")
 
     out.extend(_quant_section(windows, done))
+    out.extend(_spec_section(windows, done))
 
     if slo_windows:
         out.append("")
@@ -328,6 +368,8 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
                      "serving/handoff_requests", "serving/handoff_bytes",
                      "router/requeued", "router/shed", "router/replicas_lost",
                      "serving/quarantined", "serving/poison_retries",
+                     "serving/spec_rounds", "serving/spec_accepted_tokens",
+                     "serving/spec_rejected_tokens",
                      "serving/degrade_climbs", "serving/degrade_cfg_disabled",
                      "router/breaker_open", "router/breaker_closed",
                      "router/hedged", "router/hedge_duplicates",
