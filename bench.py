@@ -553,16 +553,93 @@ def run_bench() -> dict:
             engine_cfg=EngineConfig(num_slots=2,
                                     block_size=64 if on_tpu else 16),
         )
-        s_gen = PoissonLoadGen(4, rate=2.0 if on_tpu else 5.0, streams=2, seed=0)
-        serving_row = s_gen.run(
-            s_engine, synthetic_request_maker(cfg, seed=0),
-            max_wall_s=600 if on_tpu else 300,
-        )
+        # the Poisson run is TRACED (ISSUE 16): the row doubles as the
+        # journey-reconstruction assertion — every span emitted under real
+        # 2-stream load must stitch into a journey with zero orphans
+        import tempfile
+
+        from dalle_pytorch_tpu.observability import telemetry as _tele_mod
+
+        trace_dir = tempfile.mkdtemp(prefix="bench_serving_trace_")
+        s_tele = _tele_mod.configure(trace_dir, run_name="serving_bench",
+                                     heartbeat_s=None, watch_compiles=False)
+        try:
+            s_gen = PoissonLoadGen(4, rate=2.0 if on_tpu else 5.0, streams=2,
+                                   seed=0)
+            serving_row = s_gen.run(
+                s_engine, synthetic_request_maker(cfg, seed=0),
+                max_wall_s=600 if on_tpu else 300,
+            )
+            # terminal records for anything the wall cutoff left in flight —
+            # a journey without a terminal would count as orphan spans
+            s_engine.close()
+        finally:
+            s_tele.flush(fleet=False)
+            s_tele.close()
         serving_row["paged_pool_mb"] = round(
             s_engine.pool.bytes(2 if on_tpu else 4) / 1e6, 2)
         serving_row["slots"] = 2
+        serving_row["prefix_redundancy"] = s_engine.prefix_redundancy()
+        try:
+            sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+            import trace_report as _trace_report
+
+            _tv = _trace_report.validate_journeys(_trace_report.build_journeys(
+                _trace_report.load_records([trace_dir])))
+            serving_row["trace_orphan_spans"] = _tv["orphan_spans"]
+            serving_row["trace_multi_ack_journeys"] = _tv["multi_ack_journeys"]
+            serving_row["trace_max_phase_sum_err_s"] = _tv["max_phase_sum_err_s"]
+        except Exception as e:
+            serving_row["trace_orphan_spans"] = f"error: {e!r}"[:120]
     except Exception as e:  # the serving row must never sink the bench
         serving_row = {"error": str(e)[:200]}
+
+    # tracing-overhead row (ISSUE 16): the same engine geometry serving the
+    # same synthetic traffic untraced vs traced.  Journey tracing promises
+    # timestamps at EXISTING sync points only (PR 11 discipline), so the
+    # traced run must cost ~nothing; overhead_frac gates like health_overhead
+    tracing_overhead_row = None
+    try:
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.observability import telemetry as _tele_mod
+        from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+        _, synthetic_request_maker = _import_loadgen()
+        import tempfile
+
+        tparams = gen_params if on_tpu else state.params
+        t_engine = GenerationEngine(
+            tparams, cfg,
+            engine_cfg=EngineConfig(num_slots=2,
+                                    block_size=64 if on_tpu else 16),
+        )
+        t_make = synthetic_request_maker(cfg, seed=3)
+
+        def _timed_batch(first_i: int, n: int = 3) -> float:
+            t0 = time.perf_counter()
+            for i in range(first_i, first_i + n):
+                t_engine.submit_when_able(**t_make(i))
+            t_engine.run_until_idle()
+            return (time.perf_counter() - t0) / n
+
+        _timed_batch(0)  # warm: jit compiles + first-admit work
+        untraced = _timed_batch(10)
+        ovh_dir = tempfile.mkdtemp(prefix="bench_tracing_ovh_")
+        t_tele = _tele_mod.configure(ovh_dir, run_name="tracing_overhead",
+                                     heartbeat_s=None, watch_compiles=False)
+        try:
+            traced = _timed_batch(20)
+        finally:
+            t_tele.flush(fleet=False)
+            t_tele.close()
+        t_engine.close()
+        tracing_overhead_row = {
+            "untraced_s_per_request": round(untraced, 4),
+            "traced_s_per_request": round(traced, 4),
+            "overhead_frac": round(traced / untraced - 1.0, 4),
+        }
+    except Exception as e:  # must never sink the bench
+        tracing_overhead_row = {"error": str(e)[:200]}
 
     # serving fleet row (ISSUE 12): the same Poisson load against 2 engine
     # replicas behind the load-balancing router, plus a kill-one variant
@@ -955,6 +1032,7 @@ def run_bench() -> dict:
         "async_checkpoint": async_checkpoint_row,
         "memory": memory_row,
         "serving": serving_row,
+        "tracing_overhead": tracing_overhead_row,
         "serving_fleet": serving_fleet_row,
         "quantized_serving": quantized_serving_row,
         "quantized_parity": quantized_parity_row,
@@ -1052,6 +1130,10 @@ GATE_SPECS = {
     "speculative.accepted_tokens_per_step": ("higher", 0.5),
     "speculative.seconds_per_image": ("lower", 0.5),
     "health_overhead.overhead_frac": ("lower", 1.0),
+    # journey tracing emits spans only at existing sync points, so serving
+    # the same traffic traced must not cost more than noise — same loose
+    # doubling tolerance as the health-overhead gate
+    "tracing_overhead.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),
     "gen_full_pipeline_seconds_per_image": ("lower", 0.5),

@@ -416,8 +416,14 @@ def _replay_journal(engine, journal):
         return []
     print(f"[journal] replaying {len(payloads)} unacknowledged request(s) "
           f"from {journal.path}")
+    from dalle_pytorch_tpu.observability import tracing
+
     reqs = []
     for p in payloads:
+        # replay edge: same journey uid as the crashed process's hops (the
+        # uid IS the journal key), so trace_report stitches pre-crash admit
+        # spans and this hop into one journey across the two spans files
+        tracing.emit("replay", p["uid"], codes_done=p.get("codes_done", 0))
         reqs.append(engine.submit_when_able(
             p["text"], key=p["key"], temperature=p["temperature"],
             cond_scale=p["cond_scale"], deadline_s=p["deadline_s"],
@@ -494,6 +500,8 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
     report["quarantined"] = obs_metrics.counter("serving/quarantined").value
     report["poison_retries"] = obs_metrics.counter(
         "serving/poison_retries").value
+    if hasattr(engine, "prefix_redundancy"):
+        report["prefix_redundancy"] = engine.prefix_redundancy()
     if args.spec_k:
         rounds = obs_metrics.counter("serving/spec_rounds").value
         accepted = obs_metrics.counter("serving/spec_accepted_tokens").value

@@ -1,0 +1,93 @@
+"""Request-journey tracing: causally-linked spans across the serving fleet.
+
+PRs 12-15 let one LOGICAL request hop across engines — requeue after a
+replica loss, a hedged copy racing a stalled original, poison retries,
+journal replay after a crash, a prefill-worker handoff — but every hop left
+an isolated terminal `kind:"request"` record.  This module gives each
+logical request one **journey id** and lets every hop and edge emit a
+`kind:"trace"` event into the SAME spans JSONL the rest of the telemetry
+stack writes, so `tools/trace_report.py` can reconstruct the full journey
+(critical path, p99 attribution, Perfetto export) from one or many
+per-process files.
+
+The journey id is the journal content uid (`serving/journal.request_uid`):
+a sha1 over (key words, text ids, sampler knobs).  Every hop of the same
+logical request — the requeue copy, the hedged duplicate, the post-crash
+replay — derives the identical uid from its identical payload, which is
+what stitches hops recorded by DIFFERENT processes into one journey with no
+coordination.  Engine-local request ids are NOT stable across hops and are
+only used (together with the replica id and the hop's arrival timestamp) to
+join a hop's admit span with its terminal record.
+
+Timing discipline (PR 11): tracing introduces ZERO new host syncs.  Every
+timestamp an event carries is a `time.monotonic()` value the engine already
+took at an existing sync point (admission TTFT block, speculation's
+draft/verify boundary, the eviction pull) or pure host bookkeeping
+(queue/router/journal work).  `wall()` converts those to wall-clock with a
+per-process offset captured ONCE at import, so spans from one process share
+a consistent clock.  Across processes the stitch relies on each host's
+wall clock — NTP-level skew between machines shifts whole hops relative to
+each other (the README documents this honest negative); within one process
+the offsets cancel exactly.
+
+Emission is a no-op without active telemetry: `emit()` costs one dict
+lookup when telemetry is off, and one JSONL line when on.  No jax imports —
+tools/lint_host_sync.py lists this file as a jit-pure target.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from dalle_pytorch_tpu.observability import telemetry
+
+# monotonic -> wall anchor, captured once per process so every span this
+# process emits shares one consistent clock (the two clocks drift by at
+# most scheduler noise between the two calls below — nanoseconds, far
+# under the microsecond resolution the reports use)
+_MONO_OFFSET = time.time() - time.monotonic()
+
+
+def wall(monotonic_t: Optional[float]) -> Optional[float]:
+    """Wall-clock seconds for a `time.monotonic()` value taken in THIS
+    process (None passes through)."""
+    if monotonic_t is None:
+        return None
+    return monotonic_t + _MONO_OFFSET
+
+
+def journey_uid(req: Any) -> str:
+    """The request's journey id: the journal content uid when the request
+    was journaled, else the same sha1 computed directly (and cached on the
+    request as `trace_uid`) — so tracing works with or without a journal
+    attached, and both fields always agree."""
+    uid = getattr(req, "journal_uid", None) or getattr(req, "trace_uid", None)
+    if uid is None:
+        # function-level import: journal.py imports this module for its
+        # accept/ack edge events, so the reverse import must be lazy
+        from dalle_pytorch_tpu.serving.journal import request_uid
+
+        uid = request_uid(req.text, req.key, req.temperature, req.cond_scale)
+        try:
+            req.trace_uid = uid
+        except AttributeError:
+            pass  # journal stubs / frozen carriers: the computed uid still returns
+    return uid
+
+
+def enabled() -> bool:
+    """True when an active Telemetry will actually record trace events —
+    callers gate span-field assembly on this so telemetry-off hot paths pay
+    nothing beyond the check."""
+    return telemetry.active() is not None
+
+
+def emit(ev: str, journey: Optional[str], **fields: Any) -> None:
+    """Write one `kind:"trace"` event (`ev` names it: admit / spec_round /
+    requeue / hedge / poison_retry / replay / handoff / journal_accept /
+    journal_ack).  The span recorder stamps `ts` (wall) at write time.
+    No-op when telemetry is off."""
+    tele = telemetry.active()
+    if tele is None:
+        return
+    tele.spans.write_event("trace", ev=ev, journey=journey, **fields)

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 import os
 import signal
 import time
@@ -58,6 +59,7 @@ from dalle_pytorch_tpu.models.transformer import (
 )
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.observability import tracing
 from dalle_pytorch_tpu.ops.sampling import gumbel_sample, top_k_filter
 from dalle_pytorch_tpu.serving.kv_pool import BlockPool
 from dalle_pytorch_tpu.serving.scheduler import (
@@ -198,6 +200,19 @@ class GenerationEngine:
         self._win_decode_steps = 0
         self._win_lane_tokens = 0
         self._win_t = time.monotonic()
+        # prefix-redundancy profiler (the measured case for a prefix cache):
+        # content-hash of each admitted prompt prefix plus byte accounting
+        # for the two duplication sources — the CFG null lane (its prefix KV
+        # is text-independent, so every guided admission prefills an
+        # identical copy) and repeated prompts (hedged copies, requeues,
+        # replays, genuinely repeated text).  Pure host arithmetic at the
+        # admission sync; `prefix_redundancy()` summarizes for the bench row
+        self._prefix_seen: Dict[str, int] = {}
+        self._prefix_admissions = 0
+        self._prefix_repeats = 0
+        self._prefix_repeat_bytes = 0.0
+        self._prefix_null_bytes = 0.0
+        self._prefix_total_bytes = 0.0
         # speculative decode state: (k, d) when enabled, the draft/verify
         # jit pair (NO donation — verify needs the pre-round rings for its
         # rollback while the draft result is still live), warm-compile flag,
@@ -394,6 +409,12 @@ class GenerationEngine:
             req.deadline_s = float(deadline_s)  # host-sync-ok: CLI/host scalar
         if retries_left is not None:
             req.retries_left = int(retries_left)  # host-sync-ok: CLI/host scalar
+        # journey trace context: the content uid is computed at submit (one
+        # sha1 over host ints — journal-attached submits would compute it
+        # anyway) so every hop of a logical request carries its journey id
+        # and loadgen can aggregate per-journey without telemetry
+        req.replica = self.replica_id
+        tracing.journey_uid(req)
         self._next_id += 1
         return req
 
@@ -738,6 +759,12 @@ class GenerationEngine:
         if req.spec_rounds > 0:
             extra.setdefault("accepted_tokens_per_step",
                              round(req.accepted_tokens_per_step, 4))
+        # journey stitching fields: the content uid links this hop's record
+        # to every other hop of the same logical request; arrival_ts anchors
+        # the hop on the wall clock so trace_report can lay phases out
+        # (rounded identically to the admit span so the two join exactly)
+        extra.setdefault("journey", tracing.journey_uid(req))
+        extra.setdefault("arrival_ts", round(tracing.wall(req.arrival_t), 6))
         tele.spans.write_event(
             "request", request_id=req.id, outcome=outcome,
             guided=req.guided, synthetic=req.synthetic,
@@ -875,6 +902,66 @@ class GenerationEngine:
             self.ecfg.num_slots - len(self._free_lanes))
         obs_metrics.gauge("serving/pool_occupancy_frac").set(self.pool.occupancy_frac)
         obs_metrics.gauge("serving/pool_free_blocks").set(self.pool.free_blocks)
+        # prefix profiling + the hop's admit span: all inputs are host
+        # values this method already holds — emitted AT the existing TTFT
+        # sync, adding none
+        prefix_hash, prefix_repeat = self._note_prefix(req)
+        if tracing.enabled():
+            tracing.emit(
+                "admit", tracing.journey_uid(req), hop=req.id,
+                replica=self.replica_id,
+                arrival_ts=round(tracing.wall(req.arrival_t), 6),
+                queue_wait_s=round(req.phases["queue_wait"], 6),
+                admission_s=round(req.phases["admission"], 6),
+                prefill_s=round(req.phases["prefill"], 6),
+                ttft_s=round(req.ttft_s, 6), lanes=len(lanes),
+                mode=("handoff" if self.prefill_backend is not None
+                      else "fused"),
+                prefix_hash=prefix_hash, prefix_repeat=prefix_repeat,
+            )
+
+    def _note_prefix(self, req: Request) -> tuple:
+        """Prefix-redundancy accounting for one admission: hash the prompt,
+        price the per-lane prefix KV bytes, and attribute duplicates to the
+        null lane (text-independent by construction) and to repeated
+        prompts.  Returns (prefix_hash, seen_before)."""
+        h = hashlib.sha1(req.text.tobytes()).hexdigest()[:12]
+        per_lane = self.pool.prefix_bytes(self.n_pre)
+        self._prefix_admissions += 1
+        self._prefix_total_bytes += per_lane * req.lanes_needed
+        if req.guided:
+            self._prefix_null_bytes += per_lane
+        repeat = h in self._prefix_seen
+        if repeat:
+            self._prefix_repeats += 1
+            self._prefix_repeat_bytes += per_lane
+        self._prefix_seen[h] = self._prefix_seen.get(h, 0) + 1
+        obs_metrics.gauge("prefix/duplicate_bytes").set(
+            self._prefix_null_bytes + self._prefix_repeat_bytes)
+        obs_metrics.gauge("prefix/repeat_hit_frac").set(
+            self._prefix_repeats / self._prefix_admissions)
+        return h, repeat
+
+    def prefix_redundancy(self) -> Dict[str, Any]:
+        """The profiler's summary — how many prefill KV bytes a prefix cache
+        would have saved.  `null_lane_bytes` alone is what sharing the
+        (identical) null-conditioning prefix across guided lanes saves;
+        `repeat_prefill_bytes` adds exact-repeat prompts (hedges, requeues,
+        replays, repeated text).  The serving bench row publishes this."""
+        dup = self._prefix_null_bytes + self._prefix_repeat_bytes
+        total = self._prefix_total_bytes
+        return {
+            "admissions": self._prefix_admissions,
+            "unique_prefixes": len(self._prefix_seen),
+            "repeat_hits": self._prefix_repeats,
+            "repeat_hit_frac": (self._prefix_repeats / self._prefix_admissions
+                                if self._prefix_admissions else 0.0),
+            "null_lane_bytes": self._prefix_null_bytes,
+            "repeat_prefill_bytes": self._prefix_repeat_bytes,
+            "duplicate_bytes": dup,
+            "prefill_bytes": total,
+            "duplicate_frac": dup / total if total else 0.0,
+        }
 
     def _decode_once(self) -> None:
         if self._spec is not None and not (
@@ -918,8 +1005,10 @@ class GenerationEngine:
         self._warm_spec = True
         accepted = 0
         lane_tokens = 0
+        round_hops: Dict[str, int] = {}
         for req in self._inflight:
             adv = int(acc_np[req.lanes[0]])  # host-sync-ok: acceptance bookkeeping on the already-pulled np vector
+            round_hops[str(req.id)] = adv
             old_done = req.codes_done
             req.codes_done += adv
             req.spec_rounds += 1
@@ -949,6 +1038,16 @@ class GenerationEngine:
         self._win_spec_accepted += accepted
         self._win_spec_draft_s += t1 - t0
         self._win_spec_total_s += t2 - t0
+        if tracing.enabled():
+            # one event per round, not per request: draft/verify walls come
+            # from the t0/t1/t2 stamps the existing waived syncs bound, and
+            # `hops` maps engine request id -> accepted tokens (joined to
+            # journeys through each hop's admit span)
+            tracing.emit(
+                "spec_round", None, replica=self.replica_id,
+                draft_s=round(t1 - t0, 6), verify_s=round(t2 - t1, 6),
+                hops=round_hops,
+            )
 
     def _evict_finished(self) -> List[Request]:
         done = [r for r in self._inflight if r.codes_done >= self.n_gen]
@@ -1007,8 +1106,19 @@ class GenerationEngine:
             req.codes = None
             self.queue.requeue(req)
             obs_metrics.counter("serving/poison_retries").inc()
+            # retry hops leave no terminal record; the edge event is what
+            # lets trace_report attribute the burned attempt inside the
+            # journey (the final record's evict residual absorbs its time)
+            tracing.emit("poison_retry", tracing.journey_uid(req),
+                         hop=req.id, replica=self.replica_id,
+                         retry=req.poison_retries)
         for req in quarantine:
             obs_metrics.counter("serving/quarantined").inc()
+            # same phases-sum-to-latency contract as completed requests:
+            # the residual (earlier retry hops' decode time included) is
+            # evict, so a poisoned journey's critical path still closes
+            req.phases["evict"] = max(
+                req.latency_s - sum(req.phases.values()), 0.0)
             self._finish_record(req, "poisoned",
                                 reason="nonfinite decode logits",
                                 retries=req.poison_retries)

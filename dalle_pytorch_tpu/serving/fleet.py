@@ -45,6 +45,7 @@ import numpy as np
 
 from dalle_pytorch_tpu.observability import comms as comms_mod
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import tracing
 from dalle_pytorch_tpu.serving.engine import (
     EngineConfig,
     GenerationEngine,
@@ -159,6 +160,12 @@ class PrefillWorker:
         obs_metrics.counter("serving/handoff_requests").inc()
         obs_metrics.counter("serving/handoff_bytes").inc(
             row["bytes_per_step"])
+        # handoff edge: marks the hop's prefill as worker-produced and
+        # prices the shipped bytes (the dispatch is async — no sync here;
+        # the wall cost lands in the hop's prefill phase at the TTFT sync)
+        tracing.emit("handoff", tracing.journey_uid(req), hop=req.id,
+                     replica=req.replica, lanes=lanes,
+                     bytes=row["bytes_per_step"])
         return {"layers": layers, "code": code, "lanes": lanes,
                 "comms_row": row}
 
@@ -357,6 +364,25 @@ class ServingFleet:
 
     def memory_ledger(self, capacity_bytes: Optional[float] = None):
         return self.engines[0].memory_ledger(capacity_bytes=capacity_bytes)
+
+    def prefix_redundancy(self) -> Dict[str, Any]:
+        """Fleet-wide prefix-redundancy summary: sums the per-engine byte
+        and admission counts (repeat hits stay per-engine — each engine
+        hashes independently, so a cross-replica repeat is NOT counted; a
+        shared prefix cache would save more than this reports, making the
+        number conservative) and recomputes the fractions."""
+        parts = [e.prefix_redundancy() for e in self.engines]
+        out: Dict[str, Any] = {
+            k: sum(p[k] for p in parts)
+            for k in ("admissions", "unique_prefixes", "repeat_hits",
+                      "null_lane_bytes", "repeat_prefill_bytes",
+                      "duplicate_bytes", "prefill_bytes")
+        }
+        out["repeat_hit_frac"] = (out["repeat_hits"] / out["admissions"]
+                                  if out["admissions"] else 0.0)
+        out["duplicate_frac"] = (out["duplicate_bytes"] / out["prefill_bytes"]
+                                 if out["prefill_bytes"] else 0.0)
+        return out
 
     def handoff_ledger(self) -> Optional[Dict[str, Any]]:
         """The disaggregation comms ledger (None when not disaggregated):

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
+from dalle_pytorch_tpu.observability import tracing
 
 JOURNAL_NAME = "journal.jsonl"
 
@@ -170,6 +171,10 @@ class RequestJournal:
         self._absorb(rec)
         self._append(rec)
         obs_metrics.counter("journal/accepted").inc()
+        # journey anchor: the durability point, on the trace timeline — a
+        # journey whose first event is journal_accept in one process and
+        # whose terminal record lives in another is the crash-replay stitch
+        tracing.emit("journal_accept", uid)
         return uid
 
     def progress(self, req) -> None:
@@ -200,6 +205,7 @@ class RequestJournal:
         self._append({"kind": "ack", "uid": uid, "outcome": outcome,
                       "t": time.time()})
         obs_metrics.counter(f"journal/ack_{outcome}").inc()
+        tracing.emit("journal_ack", uid, outcome=outcome)
         return True
 
     # --------------------------------------------------------------- replay

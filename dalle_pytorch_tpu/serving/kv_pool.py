@@ -82,6 +82,23 @@ class BlockPool:
             * kv_bytes_per_elem(self.quant, itemsize, self.cfg.dim_head)
         )
 
+    def prefix_bytes(self, n_tokens: int,
+                     itemsize: Optional[int] = None) -> float:
+        """At-rest KV bytes ONE lane's `n_tokens`-long prefix occupies in
+        this pool (k + v, every layer, quantization priced by the shared
+        formula).  The prefix-redundancy profiler prices duplicated prefill
+        work with this — e.g. a guided request's null lane writes exactly
+        this many bytes of KV that are byte-identical for every guided
+        admission."""
+        from dalle_pytorch_tpu.quantization import kv_bytes_per_elem
+
+        if itemsize is None:
+            itemsize = (np.dtype(self.dtype).itemsize
+                        if self.dtype is not None else 4)
+        return (2.0 * self.cfg.depth * self.cfg.heads * n_tokens
+                * self.cfg.dim_head
+                * kv_bytes_per_elem(self.quant, itemsize, self.cfg.dim_head))
+
     # -- host free list -----------------------------------------------------
     @property
     def free_blocks(self) -> int:

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional
 
 from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
+from dalle_pytorch_tpu.observability import tracing
 from dalle_pytorch_tpu.serving.journal import request_uid
 from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused, Request
 
@@ -275,6 +276,11 @@ class Router:
                 req.hedge_uid = uid
                 self._hedged.add(uid)
                 obs_metrics.counter("router/hedged").inc()
+                # hedge edge: links the stalled hop to its racing copy so
+                # the journey's critical path attributes the wait correctly
+                tracing.emit("hedge", uid, from_replica=r.id,
+                             to_replica=copy.replica,
+                             deadline_frac=round(frac, 4))
 
     def _dedup_completions(self, done: List[Request]) -> List[Request]:
         """First-completion-wins: the second copy of a hedged pair (the
@@ -361,6 +367,12 @@ class Router:
             if placed is not None:
                 requeued.append(placed)
                 obs_metrics.counter("router/requeued").inc()
+                # requeue edge: the lost replica's hop hands off to the
+                # survivor's — same journey uid by construction (identical
+                # payload), so trace_report stitches the chain
+                tracing.emit("requeue", tracing.journey_uid(placed),
+                             from_replica=idx, to_replica=placed.replica,
+                             codes_done=exp.get("codes_done", 0))
         if exhausted:
             self._alarm({
                 "type": "requeue_exhausted", "replica": idx,
@@ -388,6 +400,7 @@ class Router:
                 guided=exp.get("cond_scale", 1.0) != 1.0,
                 decode_tokens=exp.get("codes_done", 0),
                 replica=exp.get("origin_replica"),
+                journey=uid,
             )
 
     def _alarm(self, fields: Dict[str, Any]) -> None:

@@ -84,6 +84,14 @@ class Request:
     synthetic: bool = False
     # durability trace: journal content-uid, poison retry count, hedge links
     journal_uid: Optional[str] = None
+    # journey trace context (observability/tracing.py): `trace_uid` is the
+    # same content uid computed even when no journal is attached — every
+    # hop of one logical request (requeue copy, hedged duplicate, crash
+    # replay) derives the identical uid, which is what stitches its spans
+    # into ONE journey; `replica` is the engine that created this hop (the
+    # router reads it to label requeue/hedge edge events)
+    trace_uid: Optional[str] = None
+    replica: Optional[int] = None
     poison_retries: int = 0
     poison_victim: bool = False  # chaos poison-request fault: re-NaN this
     #                              request every hop until it quarantines

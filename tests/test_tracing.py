@@ -1,0 +1,394 @@
+"""Request-journey tracing (PR 16): trace invariants under chaos.
+
+Every chaos mode the durability layer survives must also leave a coherent
+trace: kill-replica (requeue hop), crash-replay (hops from TWO process
+generations stitched by content uid), stall+hedge (parallel duplicate
+excluded from the critical path), and poison quarantine (phase sums still
+close on the failure path).  The invariants asserted here are the same ones
+`tools/trace_report.py validate_journeys` reports and the bench serving row
+gates on:
+
+* exactly one non-duplicate ack-outcome hop per completed journey,
+* zero orphan spans (every span belongs to a journey some engine
+  eventually accounted for with a terminal record),
+* the critical-path phase/gap durations sum to the end-to-end latency.
+
+Plus engine-free unit coverage for the journey-level loadgen percentiles,
+`serving_report.build_summary`, and the host-sync lint covering tracing.py.
+"""
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from dalle_pytorch_tpu.models import dalle as dalle_mod
+from dalle_pytorch_tpu.models.dalle import DALLEConfig
+from dalle_pytorch_tpu.observability import telemetry, tracing
+from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
+from dalle_pytorch_tpu.serving.journal import RequestJournal, request_uid
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import trace_report  # noqa: E402
+
+GREEDY = 1e-4  # effective argmax without temperature=0 division
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        dim=32, depth=2, num_text_tokens=64, text_seq_len=8, heads=2,
+        dim_head=8, num_image_tokens=32, image_fmap_size=4, shift_tokens=True,
+    )
+    base.update(kw)
+    return DALLEConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = tiny_cfg()
+    params = dalle_mod.init_dalle(jax.random.PRNGKey(0), cfg)
+    text = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, cfg.text_seq_len), 1, cfg.num_text_tokens))
+    return cfg, params, text
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=2, block_size=4)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _tele(dirpath, name):
+    return telemetry.configure(str(dirpath), run_name=name,
+                               heartbeat_s=None, watch_compiles=False)
+
+
+def _journeys(dirpath):
+    return trace_report.build_journeys(
+        trace_report.load_records([str(dirpath)]))
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_journey_uid_matches_journal_and_emit_is_noop_when_off(base):
+    """The journey uid IS the journal content uid (computed lazily and
+    cached when no journal stamped one), and emit() without telemetry is a
+    no-op — the hot paths pay one lookup, nothing else."""
+    cfg, params, text = base
+    assert telemetry.active() is None
+    assert tracing.enabled() is False
+    tracing.emit("admit", "deadbeef", replica=0)  # must not raise
+
+    class Carrier:
+        journal_uid = None
+        trace_uid = None
+        temperature = 1.0
+        cond_scale = 1.0
+
+    c = Carrier()
+    c.text = text[0]
+    c.key = np.asarray(jax.random.PRNGKey(3))
+    uid = tracing.journey_uid(c)
+    assert uid == request_uid(text[0], c.key, 1.0, 1.0)
+    assert c.trace_uid == uid  # cached: second call is a getattr
+    assert tracing.journey_uid(c) == uid
+    # a journaled uid wins over recomputation
+    c2 = Carrier()
+    c2.journal_uid = "feedface"
+    assert tracing.journey_uid(c2) == "feedface"
+    assert tracing.wall(None) is None
+    assert abs(tracing.wall(time.monotonic()) - time.time()) < 0.1
+
+
+def test_host_sync_lint_covers_tracing():
+    """tracing.py sits on the engine's hot paths — it must stay in the
+    jit-pure lint target set, and lint clean."""
+    from lint_host_sync import JIT_PURE, lint_paths
+
+    target = "dalle_pytorch_tpu/observability/tracing.py"
+    assert target in JIT_PURE
+    root = str(Path(__file__).resolve().parent.parent)
+    assert lint_paths(root, targets=(target,)) == []
+
+
+def test_loadgen_journey_percentiles_collapse_hops():
+    """Journey percentiles: hops sharing a content uid collapse into one
+    sample (first arrival -> FIRST completion — a hedge loser or duplicate
+    finishing later is not a second sample and does not stretch the TTLB),
+    while per-hop numbers stay visible under hop_*."""
+    from types import SimpleNamespace as NS
+
+    from loadgen import PoissonLoadGen
+
+    def hop(uid, arrival, ttft, lat):
+        return NS(journal_uid=uid, arrival_t=arrival, ttft_s=ttft,
+                  latency_s=lat, synthetic=False)
+
+    orig = hop("u1", 0.0, 0.5, None)        # deferred original (no finish)
+    requeued = hop("u1", 2.0, 0.2, 1.0)     # completes at t=3.0
+    straggler = hop("u1", 2.5, 0.2, 2.0)    # duplicate finishing at t=4.5
+    solo = hop(None, 1.0, 0.3, 0.9)         # keyed by object identity
+
+    gen = PoissonLoadGen(2, 1.0)
+    rep = gen.report([requeued, straggler, solo], refused=0, elapsed_s=5.0,
+                     submitted=[orig, solo])
+    assert rep["requests_completed"] == 3
+    assert rep["journeys_completed"] == 2
+    # journey TTLB for u1 is the FIRST completion: 3.0 - 0.0, not 4.5
+    assert rep["latency_p50_s"] == pytest.approx(
+        float(np.percentile([3.0, 0.9], 50)))
+    # journey TTFT is first-token-anywhere minus first arrival
+    assert rep["ttft_p50_s"] == pytest.approx(
+        float(np.percentile([0.5, 0.3], 50)))
+    # hop percentiles unaffected by the collapse
+    assert rep["hop_latency_p50_s"] == pytest.approx(
+        float(np.percentile([1.0, 2.0, 0.9], 50)))
+
+
+def test_serving_report_build_summary_sections():
+    """--json payload: outcomes/percentiles/fleet/durability/counters from
+    raw records, no engine needed."""
+    from serving_report import build_summary
+
+    records = [
+        {"kind": "request", "outcome": "completed", "replica": 0,
+         "ttft_s": 0.2, "latency_s": 1.0, "ts": 100.0, "hedged": True,
+         "phases": {"prefill": 0.1, "decode": 0.8}},
+        {"kind": "request", "outcome": "completed", "replica": 1,
+         "ttft_s": 0.4, "latency_s": 2.0, "ts": 103.0, "replayed": True,
+         "phases": {"decode": 1.5}},
+        {"kind": "request", "outcome": "shed", "replica": 0},
+        {"kind": "metrics", "metrics": {"serving/completed": {"total": 2}}},
+        {"kind": "alarm", "type": "replica_circuit_open"},
+    ]
+    s = build_summary(records)
+    assert s["requests"]["completed"] == 2
+    assert s["requests"]["outcomes"] == {"completed": 2, "shed": 1}
+    assert s["requests"]["images_per_sec_per_chip"] == pytest.approx(2 / 3.0)
+    assert s["fleet"]["0"]["completed"] == 1 and s["fleet"]["0"]["shed"] == 1
+    assert s["durability"]["hedged"] == 1
+    assert s["durability"]["replayed"] == 1
+    assert s["durability"]["breaker_opens"] == 1
+    assert s["counters"] == {"serving/completed": 2}
+    assert "decode" in s["phases"]
+    assert s["phases"]["decode"]["share"] > 0.5
+
+
+# ----------------------------------------------------------- chaos drills
+
+
+def test_kill_replica_journey_stitches_and_exports_perfetto(base, tmp_path):
+    """A request drained off a killed replica and completed on a survivor
+    is ONE journey: two hops on two replicas joined by a requeue edge, the
+    critical path naming the requeue_wait gap, and the Perfetto export
+    carrying a flow arrow across the two process tracks."""
+    cfg, params, text = base
+    tele = _tele(tmp_path, "kill")
+    try:
+        fleet = ServingFleet(params, cfg,
+                             fleet_cfg=FleetConfig(replicas=2, engine=_ecfg()))
+        key = jax.random.PRNGKey(33)
+        req = fleet.submit(text[2], key=key, temperature=GREEDY,
+                           retries_left=3)
+        holder = next(i for i, e in enumerate(fleet.engines)
+                      if any(r is req for r in
+                             list(e._inflight) + list(e.queue._q)))
+        while req.codes_done == 0:  # catch it MID-decode
+            fleet.engines[holder].poll()
+        requeued = fleet.kill_replica(holder)
+        assert len(requeued) == 1
+        uid = tracing.journey_uid(requeued[0])
+        fleet.run_until_idle()
+        fleet.close()
+    finally:
+        tele.close()
+
+    journeys = _journeys(tmp_path)
+    v = trace_report.validate_journeys(journeys)
+    assert v["ok"], v
+    assert v["orphan_spans"] == 0 and v["multi_ack_journeys"] == 0
+    assert v["max_phase_sum_err_s"] <= 1e-3
+
+    jj = journeys[uid]
+    assert any(e["ev"] == "requeue" for e in jj["edges"])
+    s = trace_report.summarize_journey(jj)
+    assert s["hops"] == 2 and s["ack_hops"] == 1
+    assert s["outcome"] == "completed"
+    assert len(s["replicas"]) == 2
+    assert "requeue_wait" in [name for name, _ in s["path"]]
+    assert "requeue" in s["hop_kind_s"] and "origin" in s["hop_kind_s"]
+    assert s["path_err_s"] <= 1e-3
+    assert s["ttft_s"] is not None and s["e2e_s"] >= s["ttft_s"]
+
+    trace = trace_report.to_chrome_trace({uid: jj})
+    ev = trace["traceEvents"]
+    pids = {e["pid"] for e in ev if e["ph"] == "M"
+            and e["name"] == "process_name"}
+    assert len(pids) == 2  # one process track per replica
+    slices = [e for e in ev if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == pids
+    assert all(e["dur"] >= 1.0 for e in slices)
+    starts = [e for e in ev if e["ph"] == "s"]
+    finishes = [e for e in ev if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"]
+    assert starts[0]["pid"] != finishes[0]["pid"]  # arrow crosses replicas
+    assert starts[0]["ts"] <= finishes[0]["ts"]
+    assert finishes[0]["bp"] == "e"
+
+
+def test_crash_replay_journey_stitches_across_process_generations(base,
+                                                                  tmp_path):
+    """Two spans files from two process 'generations' — the first crashed
+    mid-decode (admit span, journal accept, NO terminal record), the second
+    replayed from the WAL — stitch into one journey: the pre-crash hop is a
+    partial hop (admit-measured phases only), the replay hop acks, the gap
+    between them is named replay_wait, and nothing is orphaned even though
+    BOTH hops share engine-local id 0 (the arrival timestamp disambiguates
+    the join)."""
+    cfg, params, text = base
+    tdir = tmp_path / "tele"
+    tele1 = _tele(tdir, "gen1")
+    j1 = RequestJournal(str(tmp_path / "wal"))
+    eng1 = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+    eng1.journal = j1
+    key = jax.random.PRNGKey(21)
+    req = eng1.submit(text[0], key=key, temperature=GREEDY)
+    for _ in range(4):  # a few decode steps, then "crash" (no close, no ack)
+        eng1.poll()
+    uid = req.journal_uid
+    assert uid is not None
+    j1.close()
+
+    tele2 = _tele(tdir, "gen2")  # configure() closes gen1's telemetry
+    try:
+        from dalle_pytorch_tpu.cli.serve import _replay_journal
+
+        j2 = RequestJournal(str(tmp_path / "wal"))
+        eng2 = GenerationEngine(params, cfg, engine_cfg=_ecfg())
+        eng2.journal = j2
+        redone = _replay_journal(eng2, j2)
+        assert len(redone) == 1 and redone[0].outcome == "completed"
+        eng2.close()
+        j2.close()
+    finally:
+        tele2.close()
+
+    records = trace_report.load_records([str(tdir)])
+    assert {r.get("kind") for r in records} >= {"trace", "request"}
+    journeys = trace_report.build_journeys(records)
+    v = trace_report.validate_journeys(journeys)
+    assert v["ok"], v
+    assert v["orphan_spans"] == 0
+
+    jj = journeys[uid]
+    hops = jj["hops"]
+    assert len(hops) == 2  # same engine-local id, joined apart by arrival ts
+    partial = [h for h in hops if h["outcome"] is None]
+    acked = [h for h in hops if h["outcome"] == "completed"]
+    assert len(partial) == 1 and len(acked) == 1
+    assert partial[0]["admit"] is not None  # all we durably know of gen1
+    assert acked[0]["replayed"] is True
+    assert {e["ev"] for e in jj["edges"]} >= {"journal_accept", "replay"}
+    s = trace_report.summarize_journey(jj)
+    assert s["outcome"] == "completed"
+    assert "replay_wait" in [name for name, _ in s["path"]]
+    assert "replay" in s["hop_kind_s"]
+    # e2e spans BOTH generations: strictly more than the replay hop alone
+    assert s["e2e_s"] > acked[0]["latency_s"]
+
+
+def test_stall_hedge_journey_single_ack_parallel_loser_excluded(base,
+                                                                tmp_path):
+    """A hedged pair is one journey with exactly one ack: the loser's ack
+    is journal-suppressed (duplicate), its wall time ran PARALLEL to the
+    winner so the critical path excludes it, and the hedge edge names the
+    leading hedge_wait gap."""
+    cfg, params, text = base
+    tele = _tele(tmp_path, "hedge")
+    try:
+        fleet = ServingFleet(
+            params, cfg,
+            fleet_cfg=FleetConfig(replicas=2, engine=_ecfg(),
+                                  stall_after_s=0.05, probe_after_s=10.0,
+                                  hedge_frac=0.1))
+        fleet.attach_journal(RequestJournal(str(tmp_path / "wal")))
+        # warm the survivor path so compile latency cannot eat the wedge
+        fleet.submit(text[0], key=jax.random.PRNGKey(70), synthetic=True)
+        fleet.run_until_idle()
+        req = fleet.submit(text[1], key=jax.random.PRNGKey(66),
+                           temperature=GREEDY, deadline_s=1.0)
+        uid = req.journal_uid
+        victim = next(i for i, e in enumerate(fleet.engines)
+                      if any(r is req for r in
+                             list(e._inflight) + list(e.queue._q)))
+        fleet.engines[victim].wedge(1.5)
+        delivered = []
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 8.0:
+            delivered.extend(fleet.poll())
+            if delivered and not fleet.busy:
+                break
+        fleet.run_until_idle()  # the wedged original limps in, suppressed
+        fleet.close()
+        fleet.journal.close()
+    finally:
+        tele.close()
+
+    journeys = _journeys(tmp_path)
+    v = trace_report.validate_journeys(journeys)
+    assert v["ok"], v
+    assert v["multi_ack_journeys"] == 0 and v["orphan_spans"] == 0
+
+    jj = journeys[uid]
+    assert any(e["ev"] == "hedge" for e in jj["edges"])
+    s = trace_report.summarize_journey(jj)
+    assert s["hops"] >= 2
+    assert s["ack_hops"] == 1  # the loser is a duplicate, not a second ack
+    assert s["outcome"] == "completed"
+    assert "hedge" in s["hop_kind_s"]
+    assert "hedge_wait" in [name for name, _ in s["path"]]
+    # the loser's parallel time must NOT inflate the path sum
+    assert s["path_err_s"] <= 1e-3
+
+
+def test_poison_journey_phase_sum_closes_on_failure_path(base, tmp_path):
+    """The failure path keeps the books: a quarantined request's terminal
+    `poisoned` record still has phases summing to its latency (the evict
+    residual is stamped), with one poison_retry edge per burned retry."""
+    cfg, params, text = base
+    tele = _tele(tmp_path, "poison")
+    try:
+        eng = GenerationEngine(params, cfg,
+                               engine_cfg=_ecfg(poison_max_retries=2))
+        victim = eng.submit(text[0], key=jax.random.PRNGKey(87))
+        victim.poison_victim = True
+        cohab = eng.submit(text[1], key=jax.random.PRNGKey(88),
+                           temperature=GREEDY)
+        eng.run_until_idle()
+        assert victim.outcome == "poisoned"
+        assert cohab.outcome == "completed"
+        vuid = tracing.journey_uid(victim)
+        cuid = tracing.journey_uid(cohab)
+        eng.close()
+    finally:
+        tele.close()
+
+    journeys = _journeys(tmp_path)
+    v = trace_report.validate_journeys(journeys)
+    assert v["ok"], v
+    assert v["orphan_spans"] == 0 and v["max_phase_sum_err_s"] <= 1e-3
+
+    s = trace_report.summarize_journey(journeys[vuid])
+    assert s["outcome"] == "poisoned"  # quarantine IS the journey's ack
+    assert s["path_err_s"] <= 1e-3
+    retries = [e for e in journeys[vuid]["edges"]
+               if e["ev"] == "poison_retry"]
+    assert len(retries) == 2
+    assert trace_report.summarize_journey(journeys[cuid])["outcome"] == \
+        "completed"

@@ -93,6 +93,11 @@ JIT_PURE = (
     # (accepted-length vector, draft-boundary block) live in engine.py and
     # are waived line-by-line there
     "dalle_pytorch_tpu/models/speculative.py",
+    # journey tracing emits spans from the engine's hot paths — its promise
+    # is timestamps-at-existing-sync-points ONLY, so the module itself must
+    # never touch a device value (it imports no jax at all; this keeps any
+    # future edit honest mechanically)
+    "dalle_pytorch_tpu/observability/tracing.py",
 )
 
 WAIVER = "host-sync-ok"

@@ -11,6 +11,14 @@ completed Request records.  The report computes EXACT percentiles from those
 records (not the registry's log2-bucket histograms), which is what the
 `serving` bench row and cli/serve.py print.
 
+Percentiles are JOURNEY-level: hops of one logical request — the original
+placement plus any requeue hops, hedged duplicates, and replays, all sharing
+a content uid — collapse into one sample measured from the FIRST hop's
+arrival to the FIRST completion (first accept → final ack; a hedge loser
+finishing second is not a second sample).  On a single engine with no
+chaos, every journey is one hop and these equal the raw per-hop numbers;
+the per-hop percentiles stay available as `hop_*` fields.
+
 Usable as a module (bench.py, tests) or a CLI against a synthetic model:
 
     python tools/loadgen.py --requests 8 --rate 2 --streams 2
@@ -23,6 +31,14 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+
+def _journey_key(r) -> Any:
+    """Stable grouping key across a logical request's hops: the journal /
+    trace content uid when stamped (identical for requeues, hedges, and
+    replays by construction), else object identity (single-hop)."""
+    return (getattr(r, "journal_uid", None) or getattr(r, "trace_uid", None)
+            or getattr(r, "hedge_uid", None) or id(r))
 
 
 class PoissonLoadGen:
@@ -47,6 +63,7 @@ class PoissonLoadGen:
         from dalle_pytorch_tpu.serving.scheduler import AdmissionRefused
 
         completed: List[Any] = []
+        submitted: List[Any] = []
         synthetic_done = 0
         refused = 0
         idx = 0
@@ -57,7 +74,7 @@ class PoissonLoadGen:
                 break
             while idx < len(self.arrivals) and self.arrivals[idx][0] <= now:
                 try:
-                    engine.submit(**make_request(idx))
+                    submitted.append(engine.submit(**make_request(idx)))
                 except AdmissionRefused:
                     refused += 1
                 idx += 1
@@ -76,12 +93,13 @@ class PoissonLoadGen:
                 # loop stays responsive
                 time.sleep(min(max(self.arrivals[idx][0] - now, 0.0), 0.02))
         elapsed = time.monotonic() - t0
-        report = self.report(completed, refused, elapsed)
+        report = self.report(completed, refused, elapsed, submitted=submitted)
         report["synthetic_completed"] = synthetic_done
         return report
 
     def report(self, completed: List[Any], refused: int,
-               elapsed_s: float) -> Dict[str, Any]:
+               elapsed_s: float,
+               submitted: Optional[List[Any]] = None) -> Dict[str, Any]:
         ttfts = np.asarray([r.ttft_s for r in completed if r.ttft_s is not None])
         lats = np.asarray([r.latency_s for r in completed if r.latency_s is not None])
         # queue_wait comes from the engine's per-request phase trace: the
@@ -103,6 +121,31 @@ class PoissonLoadGen:
         def pct(a, q):
             return float(np.percentile(a, q)) if a.size else None
 
+        # journey collapse: every hop the caller saw — original submits plus
+        # completions delivered by poll (requeue hops and hedge copies arrive
+        # only through the latter) — grouped by content uid.  Journey TTFT is
+        # first-token-anywhere minus first-hop arrival; journey TTLB is the
+        # FIRST completion's finish minus first-hop arrival (a hedge loser or
+        # duplicate replay finishing later is not a second sample).
+        hops: Dict[Any, Dict[str, Any]] = {}
+        for r in list(submitted or []) + list(completed):
+            if getattr(r, "synthetic", False):
+                continue
+            # records without an arrival stamp (bare report() callers) fall
+            # back to 0.0 — single-hop journeys then equal the hop numbers
+            arr = getattr(r, "arrival_t", None) or 0.0
+            j = hops.setdefault(_journey_key(r),
+                                {"arrival": arr, "first": [], "final": []})
+            j["arrival"] = min(j["arrival"], arr)
+            if getattr(r, "ttft_s", None) is not None:
+                j["first"].append(arr + r.ttft_s)
+            if getattr(r, "latency_s", None) is not None:
+                j["final"].append(arr + r.latency_s)
+        done = [j for j in hops.values() if j["final"]]
+        j_ttfts = np.asarray([min(j["first"]) - j["arrival"]
+                              for j in done if j["first"]])
+        j_lats = np.asarray([min(j["final"]) - j["arrival"] for j in done])
+
         n = len(completed)
         spec = {}
         if accepts.size:
@@ -114,14 +157,23 @@ class PoissonLoadGen:
         return {
             "requests_completed": n,
             "requests_refused": refused,
+            "journeys_completed": len(done),
             "streams": self.streams,
             "elapsed_s": round(elapsed_s, 4),
-            "ttft_p50_s": pct(ttfts, 50),
-            "ttft_p99_s": pct(ttfts, 99),
+            # primary percentiles are journey-level (identical to per-hop on
+            # a chaos-free single engine — every journey is one hop)
+            "ttft_p50_s": pct(j_ttfts, 50),
+            "ttft_p99_s": pct(j_ttfts, 99),
             "queue_wait_p50_s": pct(qwaits, 50),
             "queue_wait_p99_s": pct(qwaits, 99),
-            "latency_p50_s": pct(lats, 50),
-            "latency_p99_s": pct(lats, 99),
+            "latency_p50_s": pct(j_lats, 50),
+            "latency_p99_s": pct(j_lats, 99),
+            # per-hop numbers stay visible: hop TTFT vs journey TTFT is the
+            # requeue/hedge tax the durability layer pays
+            "hop_ttft_p50_s": pct(ttfts, 50),
+            "hop_ttft_p99_s": pct(ttfts, 99),
+            "hop_latency_p50_s": pct(lats, 50),
+            "hop_latency_p99_s": pct(lats, 99),
             # the engine runs on ONE device; normalize per serving chip
             "images_per_sec_per_chip": (n / elapsed_s if elapsed_s > 0 else None),
             **spec,

@@ -38,10 +38,16 @@ Sections, all from the stream serving/engine.py writes:
   requests, and the degrade ladder's rung transitions plus how many
   requests were admitted under each rung (`degrade_rung` request tags).
 
+`--json` emits the same content machine-readably: one dict whose keys
+mirror the rendered sections (requests / phases / fleet / durability /
+quantization / speculation / counters), for dashboards and the bench
+harness — no screen-scraping the tables.
+
 Pure stdlib; works on a partially-written file from a live run."""
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 from typing import Any, Dict, List
@@ -253,6 +259,130 @@ def _durability_section(records: List[Dict[str, Any]],
     return out
 
 
+_COUNTER_NAMES = (
+    "serving/submitted", "serving/admitted", "serving/refused",
+    "serving/refused_queue_overflow", "serving/refused_never_fits",
+    "serving/admission_deferrals", "serving/completed",
+    "serving/flood_injected", "serving/drained",
+    "serving/handoff_requests", "serving/handoff_bytes",
+    "router/requeued", "router/shed", "router/replicas_lost",
+    "serving/quarantined", "serving/poison_retries",
+    "serving/spec_rounds", "serving/spec_accepted_tokens",
+    "serving/spec_rejected_tokens",
+    "serving/degrade_climbs", "serving/degrade_cfg_disabled",
+    "router/breaker_open", "router/breaker_closed",
+    "router/hedged", "router/hedge_duplicates",
+    "router/requeue_exhausted",
+    "journal/accepted", "journal/duplicate_acks",
+)
+
+
+def _counters(records: List[Dict[str, Any]]) -> Dict[str, float]:
+    counters: Dict[str, float] = {}
+    for r in records:
+        if r.get("kind") != "metrics":
+            continue
+        for name in _COUNTER_NAMES:
+            rec = (r.get("metrics") or {}).get(name)
+            if rec and rec.get("total") is not None:
+                counters[name] = rec["total"]
+    return counters
+
+
+def build_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The report as one JSON-ready dict — the same numbers the rendered
+    sections print, keyed by section.  This is the `--json` payload and the
+    programmatic entry point (dashboards, bench assertions)."""
+    reqs = [r for r in records
+            if r.get("kind") in ("request", "serving_request")]
+    windows = [r for r in records if r.get("kind") == "serving_window"]
+    done = [r for r in reqs if r.get("outcome", "completed") == "completed"]
+    ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
+    lats = [r["latency_s"] for r in done if r.get("latency_s") is not None]
+    ts = [r.get("ts") for r in done if r.get("ts") is not None]
+    span_s = (max(ts) - min(ts)) if len(ts) >= 2 else None
+
+    outcomes: Dict[str, int] = {}
+    for r in reqs:
+        o = r.get("outcome", "completed")
+        outcomes[o] = outcomes.get(o, 0) + 1
+
+    total_lat = sum(r.get("latency_s") or 0.0 for r in done) or 1e-12
+    phases: Dict[str, Dict[str, Any]] = {}
+    for name, _ in PHASES:
+        vals = [r["phases"][name] for r in done
+                if (r.get("phases") or {}).get(name) is not None]
+        if vals:
+            phases[name] = {
+                "mean_s": sum(vals) / len(vals),
+                "p50_s": _pct(vals, 0.50), "p99_s": _pct(vals, 0.99),
+                "share": sum(vals) / total_lat,
+            }
+
+    by_rep: Dict[str, Dict[str, Any]] = {}
+    for r in reqs:
+        if "replica" in r:
+            rep = by_rep.setdefault(str(r["replica"]),
+                                    {"completed": 0, "shed": 0, "deferred": 0,
+                                     "latencies": []})
+            o = r.get("outcome", "completed")
+            if o in rep:
+                rep[o] += 1
+            if o == "completed" and r.get("latency_s") is not None:
+                rep["latencies"].append(r["latency_s"])
+    for rep in by_rep.values():
+        lat = rep.pop("latencies")
+        rep["latency_p50_s"] = _pct(lat, 0.50)
+        rep["latency_p99_s"] = _pct(lat, 0.99)
+
+    breaker = [r for r in records if r.get("kind") == "alarm"
+               and r.get("type") == "replica_circuit_open"]
+    rungs = [r for r in records if r.get("kind") == "degrade_rung"]
+    accepts = [r["accepted_tokens_per_step"] for r in done
+               if r.get("accepted_tokens_per_step") is not None]
+    qw = [w for w in windows if w.get("weight_dtype") or w.get("kv_dtype")]
+
+    summary: Dict[str, Any] = {
+        "requests": {
+            "outcomes": outcomes,
+            "completed": len(done),
+            "guided": sum(1 for r in done if r.get("guided")),
+            "synthetic": sum(1 for r in done if r.get("synthetic")),
+            "ttft_p50_s": _pct(ttfts, 0.50), "ttft_p99_s": _pct(ttfts, 0.99),
+            "latency_p50_s": _pct(lats, 0.50),
+            "latency_p99_s": _pct(lats, 0.99),
+            "images_per_sec_per_chip": (len(done) / span_s
+                                        if span_s else None),
+        },
+        "phases": phases,
+        "fleet": by_rep,
+        "durability": {
+            "hedged": sum(1 for r in reqs if r.get("hedged")),
+            "duplicates_suppressed": sum(1 for r in reqs
+                                         if r.get("duplicate")),
+            "replayed": sum(1 for r in reqs if r.get("replayed")),
+            "breaker_opens": len(breaker),
+            "degrade_transitions": len(rungs),
+            "degrade_peak_rung": (max(r.get("rung", 0) for r in rungs)
+                                  if rungs else 0),
+        },
+        "counters": _counters(records),
+    }
+    if qw:
+        summary["quantization"] = {
+            k: qw[-1].get(k) for k in
+            ("weight_dtype", "kv_dtype", "dequant_flops_per_step",
+             "dequant_frac_of_step")}
+    if accepts:
+        summary["speculation"] = {
+            "accepted_tokens_per_step_mean": sum(accepts) / len(accepts),
+            "accepted_tokens_per_step_p50": _pct(accepts, 0.50),
+            "accepted_tokens_per_step_min": min(accepts),
+            "requests": len(accepts),
+        }
+    return summary
+
+
 def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     reqs = [r for r in records
             if r.get("kind") in ("request", "serving_request")]
@@ -357,27 +487,7 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
     elif not slo_alarms:
         out.append("backpressure alarms: none")
 
-    counters = {}
-    for r in records:
-        if r.get("kind") != "metrics":
-            continue
-        for name in ("serving/submitted", "serving/admitted", "serving/refused",
-                     "serving/refused_queue_overflow", "serving/refused_never_fits",
-                     "serving/admission_deferrals", "serving/completed",
-                     "serving/flood_injected", "serving/drained",
-                     "serving/handoff_requests", "serving/handoff_bytes",
-                     "router/requeued", "router/shed", "router/replicas_lost",
-                     "serving/quarantined", "serving/poison_retries",
-                     "serving/spec_rounds", "serving/spec_accepted_tokens",
-                     "serving/spec_rejected_tokens",
-                     "serving/degrade_climbs", "serving/degrade_cfg_disabled",
-                     "router/breaker_open", "router/breaker_closed",
-                     "router/hedged", "router/hedge_duplicates",
-                     "router/requeue_exhausted",
-                     "journal/accepted", "journal/duplicate_acks"):
-            rec = (r.get("metrics") or {}).get(name)
-            if rec and rec.get("total") is not None:
-                counters[name] = rec["total"]
+    counters = _counters(records)
     if counters:
         out.append("")
         out.append("counters (final snapshot):")
@@ -392,6 +502,9 @@ def main(argv=None) -> int:
                         help="spans JSONL files or telemetry dirs; several "
                              "merge into one report (fleet replicas)")
     parser.add_argument("--max_rows", type=int, default=20)
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable summary (same numbers as the "
+                             "rendered sections) on stdout")
     args = parser.parse_args(argv)
 
     records: List[Dict[str, Any]] = []
@@ -406,7 +519,10 @@ def main(argv=None) -> int:
         records.extend(load_records(p))
     # one merged timeline: fleet replicas each stamp ts at write time
     records.sort(key=lambda r: r.get("ts") or 0.0)
-    print(build_report(records, max_rows=args.max_rows))
+    if args.json:
+        print(json.dumps(build_summary(records), indent=2, default=float))
+    else:
+        print(build_report(records, max_rows=args.max_rows))
     return 0
 
 
