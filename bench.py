@@ -591,6 +591,24 @@ def run_bench() -> dict:
             serving_row["trace_max_phase_sum_err_s"] = _tv["max_phase_sum_err_s"]
         except Exception as e:
             serving_row["trace_orphan_spans"] = f"error: {e!r}"[:120]
+        # the same trace carries the pool flight-recorder events (ISSUE 17):
+        # the row asserts the capacity simulator reproduces THIS recorded
+        # run exactly (admit/defer decisions, occupancy, high-water) and
+        # reports the reservation waste expected-block admission would
+        # reclaim
+        try:
+            import pool_report as _pool_report
+
+            _psec = _pool_report.pool_section(
+                _trace_report.load_records([trace_dir]))
+            serving_row["pool_selfcheck_ok"] = (
+                _psec is not None and _psec["validation_ok"])
+            if _psec and _psec["pools"]:
+                _pfirst = next(iter(_psec["pools"].values()))
+                serving_row["reserved_unused_frac"] = (
+                    _pfirst["reserved_unused_frac"])
+        except Exception as e:
+            serving_row["pool_selfcheck_ok"] = f"error: {e!r}"[:120]
     except Exception as e:  # the serving row must never sink the bench
         serving_row = {"error": str(e)[:200]}
 
@@ -640,6 +658,90 @@ def run_bench() -> dict:
         }
     except Exception as e:  # must never sink the bench
         tracing_overhead_row = {"error": str(e)[:200]}
+
+    # pool-observability row (ISSUE 17): the KV-pool flight recorder's two
+    # promises, measured.  (1) Cost: the same guided-zipf traffic served
+    # recorder-off vs recorder-on — overhead_frac gates like
+    # tracing_overhead (the recorder is deque appends at existing sync
+    # points; it must cost ~nothing).  (2) Value: the recorded trace fed to
+    # tools/pool_report.py must self-validate exactly, and its what-if
+    # forecast (expected-blocks admission + prefix sharing vs worst-case
+    # whole-sequence reservation, same pool bytes) reports how many more
+    # requests this pool could admit for the repeated-prompt workload.
+    pool_observability_row = None
+    try:
+        from dalle_pytorch_tpu.cli.serve import _import_loadgen
+        from dalle_pytorch_tpu.observability import telemetry as _tele_mod
+        from dalle_pytorch_tpu.serving.engine import EngineConfig, GenerationEngine
+
+        _, synthetic_request_maker = _import_loadgen()
+        import tempfile
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+        import pool_report as _pool_report
+
+        pparams = gen_params if on_tpu else state.params
+        p_bs = 64 if on_tpu else 16
+        p_engine = GenerationEngine(
+            pparams, cfg,
+            engine_cfg=EngineConfig(num_slots=2, block_size=p_bs,
+                                    num_blocks=6 * -(-(
+                                        cfg.text_seq_len + cfg.image_seq_len)
+                                        // p_bs),
+                                    telemetry_every=4),
+        )
+        # guided + Zipf-repeated prompts: two lanes per request, and a
+        # prompt mix where prefix sharing has something to share
+        p_make = synthetic_request_maker(cfg, seed=5, cond_scale=2.0,
+                                         zipf_s=1.5, prompt_pool=4)
+
+        pool_dir = tempfile.mkdtemp(prefix="bench_pool_obs_")
+        p_tele = _tele_mod.configure(pool_dir, run_name="pool_obs",
+                                     heartbeat_s=None, watch_compiles=False)
+        try:
+            for i in range(6):
+                p_engine.submit_when_able(**p_make(i))
+            p_engine.run_until_idle()
+            # drain the recorder ring: the trace must be COMPLETE from
+            # engine birth or replay-validation would be fiction
+            p_engine.pool.recorder.flush(p_tele.spans, replica=None)
+        finally:
+            p_tele.flush(fleet=False)
+            p_tele.close()
+        _pools = _pool_report.build_pools(
+            _pool_report.load_records([pool_dir]))
+        _val = _pool_report.validate(_pools)
+        _worst = _pool_report.simulate(_pools, policy="worst", sharing=False)
+        _best = _pool_report.simulate(_pools, policy="expected", sharing=True)
+        _ratio = (
+            round(_best["admissible_slots"] / _worst["admissible_slots"], 2)
+            if _worst.get("admissible_slots") else None)
+
+        def _pool_timed(first_i: int, n: int = 3) -> float:
+            t0 = time.perf_counter()
+            for i in range(first_i, first_i + n):
+                p_engine.submit_when_able(**p_make(i))
+            p_engine.run_until_idle()
+            return (time.perf_counter() - t0) / n
+
+        _rec = p_engine.pool.recorder
+        p_engine.pool.recorder = None  # recorder-off baseline path
+        rec_off = _pool_timed(10)
+        p_engine.pool.recorder = _rec
+        rec_on = _pool_timed(20)
+        p_engine.close()
+        pool_observability_row = {
+            "recorder_off_s_per_request": round(rec_off, 4),
+            "recorder_on_s_per_request": round(rec_on, 4),
+            "overhead_frac": round(rec_on / rec_off - 1.0, 4),
+            "selfcheck_ok": _val["ok"],
+            "worst_case_admissible_slots": _worst.get("admissible_slots"),
+            "expected_sharing_admissible_slots": _best.get(
+                "admissible_slots"),
+            "overcommit_slots_ratio": _ratio,
+        }
+    except Exception as e:  # must never sink the bench
+        pool_observability_row = {"error": str(e)[:200]}
 
     # serving fleet row (ISSUE 12): the same Poisson load against 2 engine
     # replicas behind the load-balancing router, plus a kill-one variant
@@ -1033,6 +1135,7 @@ def run_bench() -> dict:
         "memory": memory_row,
         "serving": serving_row,
         "tracing_overhead": tracing_overhead_row,
+        "pool_observability": pool_observability_row,
         "serving_fleet": serving_fleet_row,
         "quantized_serving": quantized_serving_row,
         "quantized_parity": quantized_parity_row,
@@ -1134,6 +1237,9 @@ GATE_SPECS = {
     # the same traffic traced must not cost more than noise — same loose
     # doubling tolerance as the health-overhead gate
     "tracing_overhead.overhead_frac": ("lower", 1.0),
+    # the KV-pool flight recorder is deque appends at existing sync points —
+    # recorder-on serving must cost no more than noise vs recorder-off
+    "pool_observability.overhead_frac": ("lower", 1.0),
     "flagship_1p3b_depth64.mfu": ("higher", 0.15),
     "gen_seconds_per_image": ("lower", 0.5),
     "gen_full_pipeline_seconds_per_image": ("lower", 0.5),

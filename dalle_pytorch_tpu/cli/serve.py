@@ -24,7 +24,14 @@ profiler capture; `--status_json PATH` keeps an atomically-rewritten live
 snapshot (the scrape surface for a router); with `--telemetry` every request
 leaves a `kind:"request"` phase-attributed record (tools/serving_report.py
 renders the waterfall) and a stalled poll() dumps thread stacks + request
-phases via the heartbeat (`--telemetry_heartbeat_s`).
+phases via the heartbeat (`--telemetry_heartbeat_s`).  The KV-pool flight
+recorder (on by default; `--no_pool_recorder`, `--pool_recorder_capacity`)
+logs every block alloc/free/defer as `kind:"pool"` records — the status
+snapshot and final report carry the pool section (occupancy, high-water,
+reserved-unused waste, block-lifetime percentiles, overcommit forecast)
+and tools/pool_report.py replays the trace against hypothetical pool
+configs; `--zipf S` makes loadgen traffic repeat prompts Zipf-style so the
+prefix-sharing forecast has something to share.
 
 Fleet mode: `--replicas N` serves through N engine replicas behind the
 load-balancing router (serving/fleet.py); `--disaggregate` moves prefill to
@@ -113,6 +120,14 @@ def build_parser():
                      help="run prefill on a separate worker pool and hand "
                           "the KV prefix to the decode replicas (priced as a "
                           "comms-ledger handoff row)")
+    eng.add_argument("--no_pool_recorder", action="store_true",
+                     help="disable the KV-pool flight recorder (block "
+                          "lifecycle events + pool gauges; on by default, "
+                          "recorder-off is the bench baseline path)")
+    eng.add_argument("--pool_recorder_capacity", type=int, default=4096,
+                     help="flight-recorder ring size in events; overflow "
+                          "drops the oldest and is counted (a dropped trace "
+                          "refuses pool_report self-validation)")
     eng.add_argument("--spec_k", type=int, default=0,
                      help="self-speculative decoding: draft this many tokens "
                           "per round through a shallow layer prefix, verify "
@@ -180,6 +195,13 @@ def build_parser():
     traffic.add_argument("--rate", type=float, default=2.0,
                          help="loadgen requests/second per stream")
     traffic.add_argument("--streams", type=int, default=2)
+    traffic.add_argument("--zipf", type=float, default=None, metavar="S",
+                         help="loadgen prompts drawn Zipf(S)-distributed "
+                              "from a fixed pool instead of fresh-random — "
+                              "the repeated-prompt workload that exercises "
+                              "prefix sharing (tools/pool_report.py)")
+    traffic.add_argument("--prompt_pool", type=int, default=16,
+                         help="distinct prompts in the --zipf pool")
     traffic.add_argument("--top_k", type=float, default=0.9)
     traffic.add_argument("--temperature", type=float, default=1.0)
     traffic.add_argument("--cond_scale", type=float, default=1.0)
@@ -278,6 +300,8 @@ def main(argv=None):
         telemetry_every=args.telemetry_every,
         quantize_kv=None if args.quantize_kv == "none" else args.quantize_kv,
         spec_k=args.spec_k, spec_draft_layers=args.spec_draft_layers,
+        pool_recorder=not args.no_pool_recorder,
+        pool_recorder_capacity=args.pool_recorder_capacity,
     )
     if args.replicas > 1 or args.disaggregate:
         from dalle_pytorch_tpu.serving.fleet import FleetConfig, ServingFleet
@@ -461,7 +485,8 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
         report = gen.run(engine, synthetic_request_maker(
             dalle_cfg, seed=args.seed, temperature=args.temperature,
             cond_scale=args.cond_scale, deadline_s=args.deadline_s,
-            retries=args.retries,
+            retries=args.retries, zipf_s=args.zipf,
+            prompt_pool=args.prompt_pool,
         ))
     else:
         assert args.prompts, "provide --loadgen N or --prompts FILE"
@@ -502,6 +527,10 @@ def _run_traffic(args, engine, dalle_cfg, vae_cfg):
         "serving/poison_retries").value
     if hasattr(engine, "prefix_redundancy"):
         report["prefix_redundancy"] = engine.prefix_redundancy()
+    # same pool section status_json carries: free-list state always, plus
+    # the flight-recorder gauges (lifetimes, reserved-unused waste,
+    # overcommit forecast) when the recorder is on
+    report["pool"] = engine.pool_observability()
     if args.spec_k:
         rounds = obs_metrics.counter("serving/spec_rounds").value
         accepted = obs_metrics.counter("serving/spec_accepted_tokens").value

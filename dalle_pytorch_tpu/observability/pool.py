@@ -1,0 +1,217 @@
+"""Pool flight-recorder stream -> live gauges.
+
+`PoolGauges.observe` is the `on_event` tap of
+serving/kv_pool.PoolFlightRecorder: it consumes each block-lifecycle event
+AT RECORD TIME (so the gauges survive ring overflow and telemetry-off
+runs) and maintains the measurements ROADMAP item 1's overcommit design
+needs before it can land against forecasts instead of guesses:
+
+  * block-lifetime histogram — alloc->free wall seconds per lane
+    reservation (`pool/block_lifetime_p50_s` / `_p99_s`);
+  * `pool/reserved_unused_blocks` — cumulative reserved-minus-ever-written
+    blocks across freed reservations: the exact waste expected-block
+    admission would reclaim (whole-sequence reservation holds ceil(max_seq
+    / block_size) blocks per lane from admission; a drained / early-evicted
+    lane never wrote most of them);
+  * per-request block footprint percentiles — ever-written blocks summed
+    over a request's lanes (`pool/footprint_blocks_p50` / `_p99`);
+  * `pool/overcommit_safe_slots` — how many EXTRA requests past the
+    worst-case slot count the pool could admit at a target deferral
+    probability, from a normal fit to the observed footprint distribution
+    (mean + z_p * sigma per request must fit the pool).
+
+Everything here is host arithmetic on dict fields the recorder already
+stamped — no jax, no numpy, no new syncs (tools/lint_host_sync.py keeps
+this module in its jit-pure target list).  The offline twin of this math
+lives in tools/pool_report.py, which reads the same events back from
+`kind:"pool"` JSONL records.
+"""
+from __future__ import annotations
+
+import collections
+from statistics import NormalDist
+from typing import Any, Deque, Dict, List, Optional
+
+from dalle_pytorch_tpu.observability import metrics as obs_metrics
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Interpolated percentile over an already-sorted list (same rule as
+    tools/trace_report._pct; duplicated so this module stays import-light)."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(pos)  # host-sync-ok: plain-float percentile index, never traced
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def overcommit_safe_slots(footprints: List[float], num_blocks: int,
+                          worst_demand: float,
+                          target_defer_prob: float = 0.05) -> Optional[int]:
+    """Extra admissible requests past worst-case admission, at a target
+    deferral probability.
+
+    Worst-case admission fits `num_blocks // worst_demand` requests
+    (worst_demand = lanes * blocks_per_seq).  Expected-block admission can
+    instead fit the largest S whose total observed footprint stays inside
+    the pool with probability 1 - p: S*mu + z_p*sqrt(S)*sigma <= num_blocks
+    under a normal fit to per-request footprints.  Returns S - worst_slots
+    (>= 0), or None with fewer than 2 samples (no distribution to fit)."""
+    if len(footprints) < 2 or num_blocks <= 0 or worst_demand <= 0:
+        return None
+    n = len(footprints)
+    mu = sum(footprints) / n
+    var = sum((f - mu) ** 2 for f in footprints) / (n - 1)
+    sigma = var ** 0.5
+    if mu <= 0:
+        return None
+    z = NormalDist().inv_cdf(max(min(1.0 - target_defer_prob, 0.9999), 0.5))
+    s = 0
+    while s < num_blocks:  # mu >= 1 block/request bounds the scan
+        need = (s + 1) * mu + z * ((s + 1) ** 0.5) * sigma
+        if need > num_blocks:
+            break
+        s += 1
+    worst_slots = int(num_blocks // worst_demand)
+    return max(s - worst_slots, 0)
+
+
+class PoolGauges:
+    """Streaming aggregator over flight-recorder events (see module doc).
+
+    Bounded state: lifetime and footprint samples live in deques of
+    `max_samples` (oldest-out — the gauges describe recent traffic), the
+    open-allocation map is bounded by the pool itself (one entry per owned
+    lane), and per-request assembly state clears when the last lane frees.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, blocks_per_seq: int,
+                 target_defer_prob: float = 0.05, max_samples: int = 4096):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.blocks_per_seq = blocks_per_seq
+        self.target_defer_prob = target_defer_prob
+        self._open: Dict[int, Dict[str, Any]] = {}   # owner -> alloc event
+        self._req_open: Dict[Any, Dict[str, Any]] = {}  # req -> assembly
+        self._lifetimes: Deque[float] = collections.deque(maxlen=max_samples)
+        self._footprints: Deque[float] = collections.deque(maxlen=max_samples)
+        self.allocs = 0
+        self.frees = 0
+        self.truncates = 0
+        self.defers: Dict[str, int] = {}
+        self.reserved_unused_blocks = 0
+        self._freed_reserved_blocks = 0
+        # worst-case demand for the overcommit fit: mean lanes/request
+        self._lane_sum = 0
+        self._req_count = 0
+
+    # ------------------------------------------------------------- ingest
+    def observe(self, ev: Dict[str, Any]) -> None:
+        op = ev.get("op")
+        if op == "alloc":
+            self.allocs += 1
+            owner = ev.get("owner")
+            self._open[owner] = ev
+            req = ev.get("req")
+            if req is not None and (owner is None or (owner & 1) == 0):
+                lanes = ev.get("lanes") or 1
+                self._req_open[req] = {"lanes_left": lanes, "written": 0}
+                self._lane_sum += lanes
+                self._req_count += 1
+        elif op == "free":
+            self.frees += 1
+            owner = ev.get("owner")
+            alloc = self._open.pop(owner, None)
+            if alloc is None:
+                return  # recorder attached mid-run: no lifecycle to close
+            life = ev.get("mono", 0.0) - alloc.get("mono", 0.0)
+            if life >= 0.0:
+                self._lifetimes.append(life)
+            reserved = ev.get("released") or alloc.get("reserved") or 0
+            written = ev.get("written")
+            wrote = (reserved if written is None
+                     else -(-written // self.block_size))
+            self.reserved_unused_blocks += max(reserved - wrote, 0)
+            self._freed_reserved_blocks += reserved
+            req = alloc.get("req")
+            asm = self._req_open.get(req)
+            if asm is not None:
+                asm["written"] += min(wrote, reserved)
+                asm["lanes_left"] -= 1
+                if asm["lanes_left"] <= 0:
+                    self._footprints.append(asm["written"])
+                    del self._req_open[req]
+        elif op == "truncate":
+            self.truncates += 1
+        elif op == "defer":
+            kind = ev.get("defer_kind") or "other"
+            self.defers[kind] = self.defers.get(kind, 0) + 1
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> Dict[str, Any]:
+        lifetimes = sorted(self._lifetimes)
+        footprints = sorted(self._footprints)
+        frac = (self.reserved_unused_blocks / self._freed_reserved_blocks
+                if self._freed_reserved_blocks else None)
+        mean_lanes = (self._lane_sum / self._req_count
+                      if self._req_count else 1.0)
+        safe = overcommit_safe_slots(
+            list(footprints), self.num_blocks,
+            worst_demand=mean_lanes * self.blocks_per_seq,
+            target_defer_prob=self.target_defer_prob)
+        p50 = _pct(lifetimes, 50.0)
+        p99 = _pct(lifetimes, 99.0)
+        f50 = _pct(footprints, 50.0)
+        f99 = _pct(footprints, 99.0)
+        return {
+            "allocs": self.allocs,
+            "frees": self.frees,
+            "truncates": self.truncates,
+            "open_lanes": len(self._open),
+            "defer_events": dict(self.defers),
+            "block_lifetime_p50_s": None if p50 is None else round(p50, 6),
+            "block_lifetime_p99_s": None if p99 is None else round(p99, 6),
+            "reserved_unused_blocks": self.reserved_unused_blocks,
+            "reserved_unused_frac": None if frac is None else round(frac, 4),
+            "footprint_blocks_p50": None if f50 is None else round(f50, 2),
+            "footprint_blocks_p99": None if f99 is None else round(f99, 2),
+            "overcommit_safe_slots": safe,
+        }
+
+    def publish(self, dropped: int = 0) -> Dict[str, Any]:
+        """Mirror the summary into the metrics registry (gauges other
+        subsystems and tests read without touching engine internals)."""
+        s = self.summary()
+        obs_metrics.gauge("pool/reserved_unused_blocks").set(
+            s["reserved_unused_blocks"])
+        if s["reserved_unused_frac"] is not None:
+            obs_metrics.gauge("pool/reserved_unused_frac").set(
+                s["reserved_unused_frac"])
+        if s["block_lifetime_p50_s"] is not None:
+            obs_metrics.gauge("pool/block_lifetime_p50_s").set(
+                s["block_lifetime_p50_s"])
+        if s["block_lifetime_p99_s"] is not None:
+            obs_metrics.gauge("pool/block_lifetime_p99_s").set(
+                s["block_lifetime_p99_s"])
+        if s["footprint_blocks_p99"] is not None:
+            obs_metrics.gauge("pool/footprint_blocks_p99").set(
+                s["footprint_blocks_p99"])
+        if s["overcommit_safe_slots"] is not None:
+            obs_metrics.gauge("pool/overcommit_safe_slots").set(
+                s["overcommit_safe_slots"])
+        obs_metrics.gauge("pool/recorder_dropped").set(dropped)
+        return s
+
+
+def aggregate_events(events, num_blocks: int, block_size: int,
+                     blocks_per_seq: int, **kw) -> Dict[str, Any]:
+    """Offline convenience: run a recorded event list (dicts, record order)
+    through a fresh PoolGauges and return its summary."""
+    g = PoolGauges(num_blocks, block_size, blocks_per_seq, **kw)
+    for ev in events:
+        g.observe(ev)
+    return g.summary()

@@ -61,7 +61,7 @@ from dalle_pytorch_tpu.observability import metrics as obs_metrics
 from dalle_pytorch_tpu.observability import telemetry
 from dalle_pytorch_tpu.observability import tracing
 from dalle_pytorch_tpu.ops.sampling import gumbel_sample, top_k_filter
-from dalle_pytorch_tpu.serving.kv_pool import BlockPool
+from dalle_pytorch_tpu.serving.kv_pool import BlockPool, PoolFlightRecorder
 from dalle_pytorch_tpu.serving.scheduler import (
     AdmissionController,
     AdmissionRefused,
@@ -93,6 +93,13 @@ class EngineConfig:
     #                  the sequential path — same jit, same bits as before)
     spec_draft_layers: Optional[int] = None  # drafter depth d (layers [0, d)),
     #                  default depth // 2; the verify pass runs [d, depth)
+    pool_recorder: bool = True  # KV-pool flight recorder: block-lifecycle
+    #                  events into a bounded ring, flushed through telemetry
+    #                  as kind:"pool" records (off = the hooks vanish to one
+    #                  `is None` test; nothing is recorded or allocated)
+    pool_recorder_capacity: int = 4096  # ring bound; overflow drops the
+    #                  OLDEST events (counted — pool_report refuses to
+    #                  self-validate a torn trace)
 
 
 class GenerationEngine:
@@ -130,6 +137,33 @@ class GenerationEngine:
             dtype=ldtype,
             quant=kv_quant,
         )
+        # KV-pool flight recorder + live gauges (observability/pool.py):
+        # block-lifecycle events at the existing admission/eviction syncs,
+        # flushed as kind:"pool" records at the telemetry-window cadence
+        self._pool_gauges = None
+        if engine_cfg.pool_recorder:
+            from dalle_pytorch_tpu.observability.pool import PoolGauges
+
+            rec = PoolFlightRecorder(
+                capacity=engine_cfg.pool_recorder_capacity)
+            itemsize = np.dtype(ldtype).itemsize
+            rec.config = {
+                "num_blocks": self.pool.num_blocks,
+                "block_size": engine_cfg.block_size,
+                "blocks_per_seq": self.pool.blocks_per_seq,
+                "num_slots": engine_cfg.num_slots,
+                "n_pre": self.n_pre,
+                "n_gen": self.n_gen,
+                "kv_quant": kv_quant,
+                "bytes_per_block": round(
+                    self.pool.bytes(itemsize) / (self.pool.num_blocks + 1), 1),
+            }
+            self.pool.recorder = rec
+            self._pool_gauges = PoolGauges(
+                num_blocks=self.pool.num_blocks,
+                block_size=engine_cfg.block_size,
+                blocks_per_seq=self.pool.blocks_per_seq)
+            rec.on_event = self._pool_gauges.observe
         self.queue = RequestQueue(max_depth=engine_cfg.max_queue)
         self.admission = AdmissionController(
             self.pool,
@@ -574,7 +608,13 @@ class GenerationEngine:
             exports.append(_export(req, codes))
             self._finish_record(req, "deferred", requeued=True)
             for i in range(len(req.lanes)):
-                self.pool.free_table((req.id << 1) | i)
+                # KV actually written by a drained lane: prefill's n_pre
+                # tokens plus one per decode step fed (the last sampled
+                # code was never fed back) — the recorder's reserved-vs-
+                # written gap is the waste expected-block admission reclaims
+                self.pool.free_table(
+                    (req.id << 1) | i,
+                    written_tokens=self.n_pre + max(req.codes_done - 1, 0))
             all_lanes.extend(req.lanes)
             self._free_lanes.extend(req.lanes)
         self._inflight = []
@@ -813,12 +853,25 @@ class GenerationEngine:
             req = self.queue.peek()
             if req is None:
                 return
-            reason = self.admission.may_admit(
+            reason, kind = self.admission.may_admit_ex(
                 req, free_lanes=len(self._free_lanes),
                 in_flight=len(self._inflight))
             if reason is not None:
                 req.deferrals += 1  # head-of-queue waited this iteration
                 self.admission.note_deferral(reason)
+                rec = self.pool.recorder
+                if rec is not None:
+                    # the deferral decision, with the free-list state it was
+                    # made against — what lets pool_report re-derive slots/
+                    # pool deferrals exactly (headroom ones are unmodeled)
+                    rec.record(
+                        "defer", req=req.id, defer_kind=kind,
+                        lanes_needed=req.lanes_needed,
+                        blocks_needed=(req.lanes_needed
+                                       * self.pool.blocks_per_seq),
+                        free=self.pool.free_blocks,
+                        free_lanes=len(self._free_lanes),
+                        replica=self.replica_id)
                 return
             self._do_admit(self.queue.pop())
             self.admission.note_flow()
@@ -828,10 +881,23 @@ class GenerationEngine:
         req.phases["queue_wait"] = t_pop - req.arrival_t
         lanes = [self._free_lanes.pop(0) for _ in range(req.lanes_needed)]
         req.lanes = lanes
+        # prompt-prefix content hash: shared by the redundancy profiler
+        # (_note_prefix) and the flight recorder's alloc context — the key
+        # pool_report's prefix-sharing forecast refcounts on
+        phash = hashlib.sha1(req.text.tobytes()).hexdigest()[:12]
+        rec = self.pool.recorder
+        if rec is not None:
+            rec.ctx = {
+                "req": req.id, "journey": tracing.journey_uid(req),
+                "lanes": req.lanes_needed, "guided": req.guided,
+                "prefix_hash": phash, "replica": self.replica_id,
+            }
         tables = np.stack([
             self.pool.alloc_table(owner=(req.id << 1) | i)
             for i in range(len(lanes))
         ])
+        if rec is not None:
+            rec.ctx = None
         # the request's RNG stream, derived exactly as _decode_phase does
         key, k0 = jax.random.split(jnp.asarray(req.key, jnp.uint32))
         step_keys = jax.random.split(key, max(self.n_gen - 1, 1))
@@ -905,7 +971,7 @@ class GenerationEngine:
         # prefix profiling + the hop's admit span: all inputs are host
         # values this method already holds — emitted AT the existing TTFT
         # sync, adding none
-        prefix_hash, prefix_repeat = self._note_prefix(req)
+        prefix_hash, prefix_repeat = self._note_prefix(req, phash)
         if tracing.enabled():
             tracing.emit(
                 "admit", tracing.journey_uid(req), hop=req.id,
@@ -920,12 +986,13 @@ class GenerationEngine:
                 prefix_hash=prefix_hash, prefix_repeat=prefix_repeat,
             )
 
-    def _note_prefix(self, req: Request) -> tuple:
-        """Prefix-redundancy accounting for one admission: hash the prompt,
-        price the per-lane prefix KV bytes, and attribute duplicates to the
-        null lane (text-independent by construction) and to repeated
-        prompts.  Returns (prefix_hash, seen_before)."""
-        h = hashlib.sha1(req.text.tobytes()).hexdigest()[:12]
+    def _note_prefix(self, req: Request, h: str) -> tuple:
+        """Prefix-redundancy accounting for one admission: price the
+        per-lane prefix KV bytes for the already-hashed prompt `h` (the
+        admit path computes it once, shared with the flight recorder) and
+        attribute duplicates to the null lane (text-independent by
+        construction) and to repeated prompts.  Returns
+        (prefix_hash, seen_before)."""
         per_lane = self.pool.prefix_bytes(self.n_pre)
         self._prefix_admissions += 1
         self._prefix_total_bytes += per_lane * req.lanes_needed
@@ -1079,7 +1146,11 @@ class GenerationEngine:
                 req.codes = np.asarray(self._state["codes"][req.lanes[0]])  # host-sync-ok: pulling the finished slot's codes
                 self._phase_acc["block"] += time.monotonic() - t_pull
             for i in range(len(req.lanes)):
-                self.pool.free_table((req.id << 1) | i)
+                # same written-KV arithmetic as drain(): offsets stop at
+                # n_pre + codes_done - 1 (the final code is never fed back)
+                self.pool.free_table(
+                    (req.id << 1) | i,
+                    written_tokens=self.n_pre + max(req.codes_done - 1, 0))
             all_lanes.extend(req.lanes)
             self._free_lanes.extend(req.lanes)
             req.latency_s = time.monotonic() - req.arrival_t
@@ -1201,6 +1272,15 @@ class GenerationEngine:
                 **spec_fields,
                 **self.quantization_state(),
             )
+        # flight-recorder drain rides the same cadence: pending block-
+        # lifecycle events leave the ring as kind:"pool" records, and the
+        # live gauges re-publish (all host work on already-recorded dicts)
+        prec = self.pool.recorder
+        if prec is not None and tele is not None:
+            prec.flush(tele.spans, replica=self.replica_id)
+        if self._pool_gauges is not None:
+            self._pool_gauges.publish(
+                dropped=prec.dropped if prec is not None else 0)
         if self._slo is not None:
             rec = self._slo.observe(self._iter)
             if tele is not None and rec is not None:
@@ -1220,8 +1300,29 @@ class GenerationEngine:
             "pool_occupancy_frac": self.pool.occupancy_frac,
             "pool_free_blocks": self.pool.free_blocks,
         }
+        payload["pool"] = self.pool_observability()
         payload["quantization"] = self.quantization_state()
         write_status_json(self._status_path, payload)
+
+    def pool_observability(self) -> Dict[str, Any]:
+        """Live pool section for status_json and the serve report: the
+        free-list state every run has, plus the flight-recorder gauge
+        summary (block lifetimes, reserved-unused waste, footprint
+        percentiles, overcommit forecast) when the recorder is on."""
+        out: Dict[str, Any] = {
+            "num_blocks": self.pool.num_blocks,
+            "block_size": self.pool.block_size,
+            "occupancy_frac": round(self.pool.occupancy_frac, 4),
+            "free_blocks": self.pool.free_blocks,
+            "high_water": self.pool.high_water,
+            "fragmentation_frac": round(self.pool.fragmentation_frac, 4),
+        }
+        if self._pool_gauges is not None:
+            out.update(self._pool_gauges.summary())
+        rec = self.pool.recorder
+        if rec is not None:
+            out["recorder_dropped"] = rec.dropped
+        return out
 
     def quantization_state(self) -> Dict[str, Any]:
         """Active weight/KV storage dtypes + the analytic per-step dequant

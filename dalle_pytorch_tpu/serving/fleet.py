@@ -384,6 +384,25 @@ class ServingFleet:
                                  if out["prefill_bytes"] else 0.0)
         return out
 
+    def pool_observability(self) -> Dict[str, Any]:
+        """Fleet-wide pool section: each replica owns its OWN BlockPool, so
+        per-replica summaries are reported verbatim (a forecast for one
+        pool does not sum across pools) plus the additive fleet totals —
+        reserved-unused waste and the recorder drop count — and the worst
+        per-replica high-water fraction (the capacity-planning number)."""
+        per = [e.pool_observability() for e in self.engines]
+        out: Dict[str, Any] = {
+            "replicas": per,
+            "reserved_unused_blocks": sum(
+                p.get("reserved_unused_blocks") or 0 for p in per),
+            "recorder_dropped": sum(
+                p.get("recorder_dropped") or 0 for p in per),
+            "high_water_frac_max": max(
+                (p["high_water"] / p["num_blocks"] if p["num_blocks"] else 0.0)
+                for p in per),
+        }
+        return out
+
     def handoff_ledger(self) -> Optional[Dict[str, Any]]:
         """The disaggregation comms ledger (None when not disaggregated):
         one `prefill_to_decode` row, same shape as step_comms_ledger rows."""

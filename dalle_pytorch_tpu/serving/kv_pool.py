@@ -15,11 +15,24 @@ exhausted" into backpressure instead of an OOM.
 Block 0 is the TRASH block: inactive engine slots keep all-zero block
 tables, so their masked decode lanes scatter into block 0 and can only
 clobber garbage.  It is never handed out.
+
+Flight recorder: attach a `PoolFlightRecorder` (`pool.recorder = ...`) and
+every alloc_table / free_table / truncate_slot leaves a block-lifecycle
+event — owner, block ids, occupancy/high-water at that instant, monotonic
+timestamp — in a bounded in-memory ring the engine flushes through
+telemetry as `kind:"pool"` JSONL records at its window cadence.  Every
+field is a host int this ledger already holds and the hooks run inside
+calls that already sit at the engine's admission/eviction host syncs, so
+recording adds ZERO device syncs (tools/lint_host_sync.py keeps that
+mechanical); with no recorder attached the hooks are a single `is None`
+test — no event objects, no ring, nothing allocated.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -33,6 +46,70 @@ from dalle_pytorch_tpu.observability import metrics as obs_metrics
 
 class PoolExhausted(RuntimeError):
     """No free blocks for a whole-sequence allocation."""
+
+
+class PoolFlightRecorder:
+    """Bounded ring of block-lifecycle events (the KV-pool flight recorder).
+
+    `record()` appends one host dict per pool operation — capped at
+    `capacity`; under flood the OLDEST events drop (counted in `dropped`,
+    surfaced so tools/pool_report.py refuses to validate a torn trace).
+    The engine sets `ctx` to the admission context (request id, journey
+    uid, lanes, guidance, prefix hash) for the per-lane allocs of one
+    admission, and calls `flush()` at its telemetry-window cadence to
+    drain the ring through `SpanRecorder.write_event` as `kind:"pool"`
+    records.  `on_event` is the live-gauges tap
+    (observability.pool.PoolGauges.observe) — fed at record time, so the
+    gauges survive ring overflow and telemetry-off runs."""
+
+    def __init__(self, capacity: int = 4096):
+        assert capacity > 0
+        self.capacity = capacity
+        self._ring: Deque[Dict[str, Any]] = collections.deque(maxlen=capacity)
+        self.dropped = 0
+        self.config: Dict[str, Any] = {}
+        self.ctx: Optional[Dict[str, Any]] = None
+        self.on_event: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._config_flushed = False
+        self._dropped_flushed = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, op: str, **fields) -> None:
+        """One lifecycle event.  The timestamp is time.monotonic() — pure
+        host clock, taken inside a pool call the engine already made at an
+        existing sync point — and every field is a host value the caller
+        already holds."""
+        ev = {"op": op, "mono": time.monotonic(), **fields}
+        if len(self._ring) == self.capacity:
+            self.dropped += 1  # deque(maxlen) evicts the oldest silently
+        self._ring.append(ev)
+        cb = self.on_event
+        if cb is not None:
+            cb(ev)
+
+    def flush(self, spans, replica: Optional[int] = None) -> int:
+        """Drain pending events through `spans.write_event` as
+        `kind:"pool"` JSONL records.  The pool-geometry config event goes
+        out once (first flush); a drops marker follows any ring overflow
+        since the previous flush.  Returns the number of lifecycle events
+        written."""
+        if not self._config_flushed and self.config:
+            spans.write_event("pool", op="config", replica=replica,
+                              **self.config)
+            self._config_flushed = True
+        if self.dropped != self._dropped_flushed:
+            spans.write_event("pool", op="drops", replica=replica,
+                              dropped=self.dropped)
+            self._dropped_flushed = self.dropped
+        n = 0
+        while self._ring:
+            ev = self._ring.popleft()
+            ev.setdefault("replica", replica)
+            spans.write_event("pool", **ev)
+            n += 1
+        return n
 
 
 @dataclasses.dataclass
@@ -58,6 +135,9 @@ class BlockPool:
         self._free: List[int] = list(range(1, self.num_blocks + 1))
         self._owned: Dict[int, List[int]] = {}
         self._high_water = 0
+        # flight recorder (None = recording off: the hooks below reduce to
+        # one `is None` test — nothing allocated, nothing recorded)
+        self.recorder: Optional[PoolFlightRecorder] = None
 
     # -- device side --------------------------------------------------------
     def device_pool(self, dtype=None) -> dict:
@@ -162,14 +242,34 @@ class BlockPool:
         blocks = [self._free.pop() for _ in range(self.blocks_per_seq)]
         self._owned[owner] = blocks
         self._high_water = max(self._high_water, self.used_blocks)
+        rec = self.recorder
+        if rec is not None:
+            # host-ledger event emission: every field is a host int this
+            # free-list already holds, stamped inside the admission call
+            rec.record("alloc", owner=owner, blocks=list(blocks),
+                       reserved=len(blocks), occupancy=self.used_blocks,
+                       high_water=self._high_water, free=len(self._free),
+                       **(rec.ctx or {}))
         self.publish_gauges()
         return np.asarray(blocks, np.int32)  # host-sync-ok: host free-list ids
 
-    def free_table(self, owner: int) -> None:
-        """Return a request's blocks to the free list (eviction)."""
+    def free_table(self, owner: int,
+                   written_tokens: Optional[int] = None) -> None:
+        """Return a request's blocks to the free list (eviction).
+        `written_tokens` is how many KV tokens the lane actually wrote —
+        the engine knows it at its eviction sync; the recorder turns
+        (reserved - ceil(written/block_size)) into the reserved-but-unused
+        waste expected-block admission would reclaim."""
         blocks = self._owned.pop(owner, None)
         if blocks:
             self._free.extend(blocks)
+            rec = self.recorder
+            if rec is not None:
+                rec.record("free", owner=owner, released=len(blocks),
+                           written=written_tokens,
+                           occupancy=self.used_blocks,
+                           high_water=self._high_water,
+                           free=len(self._free))
             self.publish_gauges()
 
     def truncate_slot(self, owner: int, n: int) -> int:
@@ -189,8 +289,13 @@ class BlockPool:
             raise ValueError(
                 f"truncate_slot: n={n} outside [0, "
                 f"{self.blocks_per_seq * self.block_size}]")
+        live = -(-n // self.block_size)
+        rec = self.recorder
+        if rec is not None:
+            rec.record("truncate", owner=owner, tokens=n, live_blocks=live,
+                       occupancy=self.used_blocks, free=len(self._free))
         self.publish_gauges()
-        return -(-n // self.block_size)
+        return live
 
     def owners(self) -> List[int]:
         return list(self._owned)

@@ -225,12 +225,25 @@ class AdmissionController:
         so deferring can never lower usage — it would just livelock the
         service (the override is counted, and external memory pressure
         still shows up through the HbmMonitor alarm)."""
+        return self.may_admit_ex(req, free_lanes, in_flight=in_flight)[0]
+
+    def may_admit_ex(self, req: Request, free_lanes: int,
+                     in_flight: int = 0) -> tuple:
+        """(reason, kind) — the deferral reason plus its machine-readable
+        class ("slots" / "pool" / "headroom"), or (None, None) when the
+        request may enter now.  The kind is what the pool flight recorder
+        logs per deferral, and the only classes the capacity simulator can
+        re-derive from a trace: slots and pool deferrals are pure free-list
+        arithmetic it replays exactly; headroom deferrals depend on live
+        allocator stats and are reported as unmodeled."""
         if free_lanes < req.lanes_needed:
-            return f"no free slot ({free_lanes} free, {req.lanes_needed} needed)"
+            return (f"no free slot ({free_lanes} free, "
+                    f"{req.lanes_needed} needed)", "slots")
         if self.pool.free_blocks < req.lanes_needed * self.pool.blocks_per_seq:
             return (
                 f"pool exhausted ({self.pool.free_blocks} blocks free, "
-                f"{req.lanes_needed * self.pool.blocks_per_seq} needed)"
+                f"{req.lanes_needed * self.pool.blocks_per_seq} needed)",
+                "pool",
             )
         usage = None
         try:
@@ -240,9 +253,10 @@ class AdmissionController:
         if usage is not None and usage >= self.headroom_frac:
             if in_flight > 0:
                 return (f"HBM headroom ({usage:.2f} >= "
-                        f"{self.headroom_frac:.2f} usage fraction)")
+                        f"{self.headroom_frac:.2f} usage fraction)",
+                        "headroom")
             obs_metrics.counter("serving/headroom_overrides").inc()
-        return None
+        return (None, None)
 
     def _alarm_once(self, reason: str) -> None:
         if not self._alarmed:

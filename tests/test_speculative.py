@@ -237,6 +237,9 @@ def test_engine_spec_parity_cfg_lanes(base):
     _engine_parity(cfg, params, text)
 
 
+@pytest.mark.slow  # tier-1 budget: int8 composition rides the slow tier
+# (test_engine_spec_parity_cfg_lanes is the fast twin; the slow
+# test_engine_spec_parity_matrix composes int8 with the other variants).
 def test_engine_spec_parity_int8_kv(base):
     """Same gate with the paged pool stored int8 (per-token scales are
     rewritten on every speculative position, accepted or rejected)."""
@@ -342,6 +345,9 @@ def test_degrade_suppress_spec_rungs():
         assert lad.suppress_spec is want
 
 
+@pytest.mark.slow  # tier-1 budget: the engine-level rung drill rides the
+# slow tier (test_degrade_suppress_spec_rungs pins the rung table fast;
+# the fleet load-shed tests exercise ladder pressure in tier 1).
 def test_degrade_rung2_falls_back_to_sequential(base):
     """Engine with spec armed + ladder at cap_candidates: the poll must run
     the sequential decode jit (zero spec rounds), stay bit-exact for the
@@ -370,6 +376,9 @@ def test_degrade_rung2_falls_back_to_sequential(base):
 # --------------------------------------------------- drain mid-speculation
 
 
+@pytest.mark.slow  # tier-1 budget: the spec-engine drain leg rides the
+# slow tier (the fast-tier drain-resubmit exactness twins live in
+# tests/test_fleet_serving.py on the sequential engine).
 def test_drain_mid_speculation_resubmit_exact(base):
     """Drain between verify rounds: the export carries only VERIFIED codes,
     and a second replica resubmitting (same text, same key) completes the

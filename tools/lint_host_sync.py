@@ -16,6 +16,10 @@ this lint makes that promise mechanical for the modules meant to keep it:
                                                  half lives in health_host.py)
     dalle_pytorch_tpu/quantization.py    (quantize/dequant trace inside the
                                           paged decode + prefill jits)
+    dalle_pytorch_tpu/observability/pool.py  (pool flight-recorder gauges —
+                                          inline on every alloc/free; plus
+                                          the recorder hooks in serving/
+                                          kv_pool.py via the serving target)
 
 Flagged call shapes:
 
@@ -98,6 +102,13 @@ JIT_PURE = (
     # never touch a device value (it imports no jax at all; this keeps any
     # future edit honest mechanically)
     "dalle_pytorch_tpu/observability/tracing.py",
+    # the pool-gauges aggregator is the flight recorder's on_event tap: it
+    # runs inline with every kv_pool alloc/free on the engine's poll path.
+    # It must stay pure host arithmetic over dict fields the recorder
+    # already stamped (no jax/numpy imports at all); the recorder hooks
+    # themselves live in serving/kv_pool.py, already covered by the
+    # dalle_pytorch_tpu/serving directory target above
+    "dalle_pytorch_tpu/observability/pool.py",
 )
 
 WAIVER = "host-sync-ok"

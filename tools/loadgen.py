@@ -183,18 +183,39 @@ class PoissonLoadGen:
 def synthetic_request_maker(cfg, seed: int = 0, temperature: float = 1.0,
                             cond_scale: float = 1.0,
                             deadline_s: Optional[float] = None,
-                            retries: Optional[int] = None):
+                            retries: Optional[int] = None,
+                            zipf_s: Optional[float] = None,
+                            prompt_pool: int = 16):
     """Random-prompt submit() kwargs factory (drills, bench, smoke tests).
     `deadline_s`/`retries` attach the PR 14 durability budget to every
-    request (hedge eligibility + bounded requeue hops)."""
+    request (hedge eligibility + bounded requeue hops).
+
+    `zipf_s` switches from fresh-random prompts to Zipf-distributed draws
+    from a fixed pool of `prompt_pool` prompts (rank r drawn with weight
+    r^-s): the repeated-prompt workload that makes the KV pool's prefix-
+    sharing forecast (tools/pool_report.py) non-trivial — real image
+    frontends re-submit trending prompts, they don't draw fresh ones."""
     import jax
 
     rng = np.random.RandomState(seed)
+    pool = None
+    weights = None
+    if zipf_s is not None:
+        assert zipf_s > 0 and prompt_pool > 0
+        pool = rng.randint(1, cfg.num_text_tokens,
+                           size=(prompt_pool, cfg.text_seq_len))
+        ranks = np.arange(1, prompt_pool + 1, dtype=np.float64)
+        weights = ranks ** -zipf_s
+        weights /= weights.sum()
 
     def make(i: int) -> Dict[str, Any]:
+        if pool is None:
+            text = rng.randint(1, cfg.num_text_tokens,
+                               size=(cfg.text_seq_len,))
+        else:
+            text = pool[rng.choice(len(pool), p=weights)]
         kw = {
-            "text": rng.randint(1, cfg.num_text_tokens,
-                                size=(cfg.text_seq_len,)),
+            "text": text,
             "key": jax.random.PRNGKey(seed * 100003 + i),
             "temperature": temperature,
             "cond_scale": cond_scale,
@@ -216,6 +237,12 @@ def main(argv=None) -> int:
                         help="requests/second per stream")
     parser.add_argument("--streams", type=int, default=2)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--zipf", type=float, default=None, metavar="S",
+                        help="draw prompts Zipf(S)-distributed from a fixed "
+                             "pool instead of fresh-random (prefix-sharing "
+                             "workload; see tools/pool_report.py)")
+    parser.add_argument("--prompt_pool", type=int, default=16,
+                        help="distinct prompts in the --zipf pool")
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--block_size", type=int, default=16)
     parser.add_argument("--dim", type=int, default=64)
@@ -247,7 +274,8 @@ def main(argv=None) -> int:
     )
     gen = PoissonLoadGen(args.requests, args.rate, streams=args.streams,
                          seed=args.seed)
-    report = gen.run(engine, synthetic_request_maker(cfg, seed=args.seed))
+    report = gen.run(engine, synthetic_request_maker(
+        cfg, seed=args.seed, zipf_s=args.zipf, prompt_pool=args.prompt_pool))
     if args.json:
         print(json.dumps(report))
     else:

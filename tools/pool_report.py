@@ -1,0 +1,784 @@
+#!/usr/bin/env python
+"""KV-pool flight-recorder report + trace-driven capacity simulator.
+
+The serving engine's BlockPool records every alloc/free/truncate/defer as a
+`kind:"pool"` JSONL event (serving/kv_pool.PoolFlightRecorder) — owner,
+block ids, occupancy and high-water at that instant, the admission context
+(journey uid, lanes, guidance, prompt prefix hash), and the written-KV
+count at free time.  This tool reads those events back — from ONE OR MANY
+per-process `*.spans.jsonl` files, tolerating torn final lines from crashed
+writers — and answers two questions:
+
+  * WHAT HAPPENED: per-pool lifecycle summary — block-lifetime p50/p99,
+    reserved-but-unused waste (whole-sequence reservation minus KV actually
+    written: the exact blocks expected-block admission would reclaim),
+    per-request footprint percentiles, and the overcommit-safe-slots fit.
+
+  * WHAT IF: replay the recorded admission/free trace against hypothetical
+    configurations — pool size x block size x admission policy (worst-case
+    whole-sequence vs expected-blocks with growth + preemption) x prefix
+    sharing (refcounted shared prefix blocks keyed on the recorded prompt
+    hashes; a guided request's null-lane prefix is one shared key for ALL
+    guided requests) — forecasting admitted slots, deferral/shed counts,
+    preemptions, and peak occupancy per configuration.
+
+Self-validation: `validate()` replays the trace at the ACTUAL recorded
+configuration with pure free-list arithmetic and must reproduce the
+recorded occupancy / high-water / free-list size AT EVERY EVENT plus agree
+with every recorded slots/pool deferral decision — exactly, or the tool
+says so.  A trace whose recorder ring overflowed (op:"drops") refuses to
+validate: dropped events make replay fiction.
+
+Honest caveat (also in the README): the simulator replays the RECORDED
+admission order and holds each request's decode duration fixed, so it
+cannot model admission-order feedback — a config that admits earlier would
+change arrival/completion interleaving, queueing, and therefore the very
+trace being replayed.  Forecasts are capacity arithmetic, not a queueing
+model.
+
+Stdlib-only on purpose: reads the same JSONL telemetry_report reads, runs
+anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+# ordered most → least conservative; the default forecast grid
+POLICIES = ("worst", "expected")
+
+_MISMATCH_CAP = 20  # mismatches reported per pool before truncation
+
+
+# --------------------------------------------------------------------- load
+def load_records(paths) -> List[Dict[str, Any]]:
+    """Records from files and/or directories (every *.spans.jsonl inside a
+    directory).  Torn lines are skipped: a record that was not durable
+    never happened (same rule as trace_report / the request journal)."""
+    files: List[Path] = []
+    for p in paths:
+        pth = Path(p)
+        if pth.is_dir():
+            files.extend(sorted(pth.glob("*.spans.jsonl")))
+        else:
+            files.append(pth)
+    records: List[Dict[str, Any]] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return records
+
+
+# -------------------------------------------------------------------- build
+def build_pools(records: List[Dict[str, Any]]) -> Dict[Any, Dict[str, Any]]:
+    """Group kind:"pool" events per replica (each replica owns its OWN
+    BlockPool, so replay never mixes them).  Events keep record order —
+    the recorder flushes its ring in order, and within one process that IS
+    monotonic order — with a stable mono sort as a belt-and-braces pass.
+    Each pool gets `requests`: paired alloc->free lifecycles assembled into
+    logical requests (owner = (req_id << 1) | lane)."""
+    pools: Dict[Any, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") != "pool":
+            continue
+        rep = r.get("replica")
+        p = pools.setdefault(rep, {"replica": rep, "config": None,
+                                   "events": [], "dropped": 0})
+        op = r.get("op")
+        if op == "config":
+            p["config"] = r
+        elif op == "drops":
+            p["dropped"] = max(p["dropped"], r.get("dropped") or 0)
+        else:
+            p["events"].append(r)
+    for p in pools.values():
+        p["events"].sort(key=lambda e: e.get("mono") or 0.0)  # stable
+        p["requests"] = _pair_requests(p["events"])
+    return pools
+
+
+def _pair_requests(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """alloc/free lifecycles -> logical requests.  A request id can recur
+    (poison-retry readmission): each admission opens a NEW occurrence."""
+    requests: List[Dict[str, Any]] = []
+    open_owner: Dict[Any, Dict[str, Any]] = {}   # owner -> alloc event
+    open_req: Dict[Any, Dict[str, Any]] = {}     # req id -> occurrence
+    last_mono = 0.0
+    for ev in events:
+        last_mono = max(last_mono, ev.get("mono") or 0.0)
+        op = ev.get("op")
+        if op == "alloc":
+            owner = ev.get("owner")
+            open_owner[owner] = ev
+            rid = ev.get("req")
+            occ = open_req.get(rid)
+            if occ is None:
+                occ = {
+                    "req": rid, "journey": ev.get("journey"),
+                    "t_admit": ev.get("mono"),
+                    "t_free": None,
+                    "lanes": ev.get("lanes") or 1,
+                    "guided": bool(ev.get("guided")),
+                    "prefix_hash": ev.get("prefix_hash"),
+                    "reserved": 0, "written": [], "lanes_freed": 0,
+                }
+                open_req[rid] = occ
+                requests.append(occ)
+            occ["reserved"] += ev.get("reserved") or 0
+        elif op == "free":
+            alloc = open_owner.pop(ev.get("owner"), None)
+            if alloc is None:
+                continue  # recorder attached mid-run
+            occ = open_req.get(alloc.get("req"))
+            if occ is None:
+                continue
+            occ["written"].append(ev.get("written"))
+            occ["lanes_freed"] += 1
+            if occ["lanes_freed"] >= occ["lanes"]:
+                occ["t_free"] = ev.get("mono")
+                del open_req[alloc.get("req")]
+    # still-open occurrences (engine closed mid-flight): close at the last
+    # observed instant so replay holds their blocks to end-of-trace
+    for occ in open_req.values():
+        occ["t_free"] = last_mono
+    return requests
+
+
+# ----------------------------------------------------------------- validate
+def validate(pools: Dict[Any, Dict[str, Any]]) -> Dict[str, Any]:
+    """Replay each pool's event stream at the RECORDED configuration and
+    check the free-list arithmetic reproduces every recorded instant:
+    occupancy, high-water, and free count on each alloc/free, the free-
+    lanes/free-blocks state behind every slots/pool deferral decision, and
+    the live-block arithmetic of every truncate.  Exact or it says why."""
+    per: Dict[str, Any] = {}
+    ok = True
+    for rep, p in sorted(pools.items(), key=lambda kv: str(kv[0])):
+        cfg = p["config"] or {}
+        nb = cfg.get("num_blocks")
+        slots = cfg.get("num_slots")
+        bs = cfg.get("block_size")
+        mism: List[str] = []
+        if nb is None:
+            mism.append("no config event (trace predates the recorder?)")
+            nb, slots, bs = 0, 0, 1
+        free = nb
+        hw = 0
+        open_lanes = 0
+        admitted = 0
+        defer = {"slots": 0, "pool": 0, "headroom": 0, "other": 0}
+        defer_checked = 0
+        defer_agreed = 0
+        rec_hw = 0
+
+        def note(msg):
+            if len(mism) < _MISMATCH_CAP:
+                mism.append(msg)
+
+        for i, ev in enumerate(p["events"]):
+            op = ev.get("op")
+            if op == "alloc":
+                free -= ev.get("reserved") or 0
+                open_lanes += 1
+                occ_now = nb - free
+                hw = max(hw, occ_now)
+                rec_hw = max(rec_hw, ev.get("high_water") or 0)
+                if free < 0:
+                    note(f"event {i}: free list went negative ({free})")
+                if (occ_now != ev.get("occupancy")
+                        or hw != ev.get("high_water")
+                        or free != ev.get("free")):
+                    note(f"event {i} alloc: sim occ/hw/free "
+                         f"{occ_now}/{hw}/{free} != recorded "
+                         f"{ev.get('occupancy')}/{ev.get('high_water')}"
+                         f"/{ev.get('free')}")
+                if (ev.get("owner") or 0) & 1 == 0:
+                    admitted += 1
+            elif op == "free":
+                free += ev.get("released") or 0
+                open_lanes -= 1
+                occ_now = nb - free
+                if (occ_now != ev.get("occupancy")
+                        or free != ev.get("free")):
+                    note(f"event {i} free: sim occ/free {occ_now}/{free} != "
+                         f"recorded {ev.get('occupancy')}/{ev.get('free')}")
+            elif op == "truncate":
+                want = -(-(ev.get("tokens") or 0) // bs)
+                if want != ev.get("live_blocks"):
+                    note(f"event {i} truncate: ceil({ev.get('tokens')}/{bs})"
+                         f"={want} != recorded {ev.get('live_blocks')}")
+            elif op == "defer":
+                kind = ev.get("defer_kind") or "other"
+                defer[kind] = defer.get(kind, 0) + 1
+                if kind == "slots":
+                    defer_checked += 1
+                    free_lanes = slots - open_lanes
+                    agree = free_lanes < (ev.get("lanes_needed") or 1)
+                    if free_lanes != ev.get("free_lanes"):
+                        note(f"event {i} defer: sim free_lanes {free_lanes} "
+                             f"!= recorded {ev.get('free_lanes')}")
+                    elif agree:
+                        defer_agreed += 1
+                elif kind == "pool":
+                    defer_checked += 1
+                    agree = free < (ev.get("blocks_needed") or 0)
+                    if free != ev.get("free"):
+                        note(f"event {i} defer: sim free {free} != "
+                             f"recorded {ev.get('free')}")
+                    elif agree:
+                        defer_agreed += 1
+                # headroom: live allocator state, unmodeled by design
+        row = {
+            "events": len(p["events"]),
+            "admitted": admitted,
+            "deferral_events": sum(defer.values()),
+            "deferrals_by_kind": {k: v for k, v in defer.items() if v},
+            "deferrals_replayed": defer_checked,
+            "deferrals_agreed": defer_agreed,
+            "high_water": hw,
+            "recorded_high_water": rec_hw,
+            "dropped": p["dropped"],
+            "mismatches": mism,
+        }
+        row["ok"] = (not mism and hw == rec_hw
+                     and defer_agreed == defer_checked
+                     and p["dropped"] == 0)
+        if p["dropped"]:
+            row["mismatches"] = mism + [
+                f"{p['dropped']} events dropped by the recorder ring — "
+                "replay of a torn trace is fiction; raise "
+                "--pool_recorder_capacity"]
+            row["ok"] = False
+        ok = ok and row["ok"]
+        per[str(rep)] = row
+    return {"ok": ok and bool(per), "pools": per}
+
+
+# ----------------------------------------------------------------- simulate
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _timeavg_blocks(n_pre: int, written: int, bs: int) -> float:
+    """Mean of ceil(v / bs) for v uniform over the written-token range
+    [n_pre + 1, written] — the steady-state block footprint of one lane
+    whose KV grows linearly over its residency (prefill lands n_pre + the
+    first code's feed token; decode adds one per step)."""
+    lo_tok = n_pre + 1
+    hi_tok = max(written, lo_tok)
+    total = 0
+    for m in range(_ceil_div(lo_tok, bs), _ceil_div(hi_tok, bs) + 1):
+        lo = max((m - 1) * bs + 1, lo_tok)
+        hi = min(m * bs, hi_tok)
+        total += m * (hi - lo + 1)
+    return total / (hi_tok - lo_tok + 1)
+
+
+def _lane_keys(r: Dict[str, Any]) -> List[tuple]:
+    """Sharing key per lane: the prompt-prefix hash for the cond lane; ONE
+    key for every guided request's null lane (its prefix KV is
+    text-independent, byte-identical across guided admissions)."""
+    keys = [("p", r.get("prefix_hash"))]
+    if r.get("lanes", 1) > 1:
+        keys.append(("null",))
+    return keys
+
+
+def simulate(pools: Dict[Any, Dict[str, Any]], *,
+             pool_blocks: Optional[int] = None,
+             block_size: Optional[int] = None,
+             policy: str = "worst",
+             sharing: bool = False,
+             slots: Optional[int] = None) -> Dict[str, Any]:
+    """Replay every pool's recorded request stream (admission order and
+    per-request decode durations fixed — see the module caveat) against a
+    hypothetical configuration; returns per-replica forecasts + totals.
+
+    `pool_blocks` defaults to the recorded block count rescaled to the SAME
+    POOL BYTES at the hypothetical `block_size` (bytes/block scales with
+    block_size); `slots` <= 0 means unlimited lanes (pure pool capacity
+    question)."""
+    assert policy in POLICIES, policy
+    per = []
+    for rep, p in sorted(pools.items(), key=lambda kv: str(kv[0])):
+        per.append(_simulate_one(p, pool_blocks=pool_blocks,
+                                 block_size=block_size, policy=policy,
+                                 sharing=sharing, slots=slots))
+    out: Dict[str, Any] = {
+        "policy": policy, "sharing": sharing,
+        "replicas": per,
+    }
+    for k in ("admitted", "completed", "deferred", "shed", "preemptions",
+              "admissible_slots"):
+        out[k] = sum(r[k] for r in per if r.get(k) is not None)
+    out["peak_occupancy_blocks"] = max(
+        (r["peak_occupancy_blocks"] for r in per), default=0)
+    out["peak_concurrent_requests"] = max(
+        (r["peak_concurrent_requests"] for r in per), default=0)
+    return out
+
+
+def _simulate_one(p: Dict[str, Any], *, pool_blocks, block_size, policy,
+                  sharing, slots) -> Dict[str, Any]:
+    cfg = p["config"] or {}
+    bs0 = cfg.get("block_size") or 1
+    nb0 = cfg.get("num_blocks") or 0
+    n_pre = cfg.get("n_pre") or 1
+    n_gen = cfg.get("n_gen") or 1
+    # max KV one lane ever writes: prefill + every fed decode token
+    seq_tokens = n_pre + n_gen - 1
+    bs = block_size or bs0
+    bps = _ceil_div(seq_tokens, bs)
+    # fixed pool BYTES by default: bytes/block scales linearly with bs
+    B = pool_blocks if pool_blocks is not None else int(nb0 * bs0 // bs)
+    S = cfg.get("num_slots") if slots is None else slots
+    if not S or S <= 0:
+        S = 1 << 30  # unlimited: the pool is the only constraint
+    shared_full = (n_pre // bs) if sharing else 0
+
+    reqs = sorted(p["requests"], key=lambda r: r.get("t_admit") or 0.0)
+
+    def lane_written(r):
+        ws = [w for w in r["written"] if w is not None]
+        default = seq_tokens
+        out = []
+        for i in range(r["lanes"]):
+            out.append(ws[i] if i < len(ws) else default)
+        return out
+
+    def lane_init_blocks():
+        # expected-block admission: prefill's n_pre tokens + the first
+        # code's feed slot are written before the request ever decodes
+        return _ceil_div(min(n_pre + 1, seq_tokens), bs)
+
+    # ---------------- analytic capacity: admissible slots at steady state
+    # Per-request PRIVATE demand (steady-state time-averaged blocks minus
+    # the shareable prefix portion) plus the expected number of DISTINCT
+    # prefix keys among S concurrent requests drawn from the trace's
+    # empirical key mix: E[distinct] = sum_k 1 - (1 - q_k)^S, q_k = the
+    # fraction of requests using key k.  With all-distinct prompts this
+    # degenerates to ~S keys (sharing buys nothing, ratio -> 1); with a
+    # Zipf-repeated prompt pool the distinct count saturates at the pool
+    # size and admissible slots grow accordingly.
+    steady: List[float] = []
+    key_count: Dict[tuple, int] = {}
+    for r in reqs:
+        d = 0.0
+        for w in lane_written(r):
+            if policy == "worst":
+                d += bps
+            else:
+                d += _timeavg_blocks(n_pre, w, bs)
+            d -= shared_full  # prefix blocks accounted via keys, below
+        steady.append(max(d, 0.0))
+        if sharing:
+            for k in set(_lane_keys(r)):
+                key_count[k] = key_count.get(k, 0) + 1
+    mean_steady = (sum(steady) / len(steady)) if steady else None
+    admissible = None
+    shared_pool = 0
+    if mean_steady is not None:
+        qs = [c / len(reqs) for c in key_count.values()]
+
+        def shared_at(s):
+            return shared_full * sum(1.0 - (1.0 - q) ** s for q in qs)
+
+        cap = min(10 * max(B, 1) + 16, 4096)  # scan bound, far past any
+        s = 0                                 # real answer for these pools
+        while s < cap and ((s + 1) * mean_steady + shared_at(s + 1)) <= B:
+            s += 1
+        admissible = s
+        shared_pool = int(round(shared_at(s))) if s else 0
+
+    # ---------------- event replay
+    free = B
+    free_lanes = S
+    refs: Dict[tuple, int] = {}
+    active: Dict[int, Dict[str, Any]] = {}  # uid -> live state
+    pending: List[int] = []                 # uids, FIFO (head-of-line)
+    heap: List[tuple] = []
+    seq = 0
+    n = {"admitted": 0, "completed": 0, "deferred": 0, "shed": 0,
+         "preemptions": 0}
+    peak_occ = 0
+    peak_conc = 0
+
+    state = {uid: {"r": r, "epoch": 0} for uid, r in enumerate(reqs)}
+
+    def push(t, kind, uid, epoch):
+        nonlocal seq
+        heapq.heappush(heap, (t, seq, kind, uid, epoch))
+        seq += 1
+
+    def demand_for(uid):
+        r = state[uid]["r"]
+        d = 0
+        new_keys = set()
+        for _ in range(r["lanes"]):
+            total = bps if policy == "worst" else lane_init_blocks()
+            d += max(total - shared_full, 0)
+        if sharing:
+            for k in _lane_keys(r):
+                if refs.get(k, 0) == 0 and k not in new_keys:
+                    new_keys.add(k)
+                    d += shared_full
+        return d, new_keys
+
+    def try_admit(uid, t):
+        nonlocal free, free_lanes, peak_occ, peak_conc
+        r = state[uid]["r"]
+        if free_lanes < r["lanes"]:
+            return False
+        d, new_keys = demand_for(uid)
+        if d > free:
+            return False
+        free -= d
+        free_lanes -= r["lanes"]
+        n["admitted"] += 1
+        for k in _lane_keys(r):
+            refs[k] = refs.get(k, 0) + 1
+        private = d - shared_full * len(new_keys)
+        st = state[uid]
+        st["epoch"] += 1
+        active[uid] = {"private": private, "t_admit": t}
+        hold = max((r["t_free"] or t) - (r["t_admit"] or t), 0.0)
+        push(t + hold, "free", uid, st["epoch"])
+        if policy == "expected":
+            for i, w in enumerate(lane_written(r)):
+                m0 = lane_init_blocks()
+                mW = _ceil_div(max(w, 1), bs)
+                span = max(w - (n_pre + 1), 1)
+                for m in range(m0 + 1, mW + 1):
+                    frac = ((m - 1) * bs + 1 - (n_pre + 1)) / span
+                    push(t + hold * min(max(frac, 0.0), 1.0), "grow",
+                         uid, st["epoch"])
+        peak_occ = max(peak_occ, B - free)
+        peak_conc = max(peak_conc, len(active))
+        return True
+
+    def release(uid):
+        nonlocal free, free_lanes
+        st = active.pop(uid)
+        r = state[uid]["r"]
+        free += st["private"]
+        free_lanes += r["lanes"]
+        for k in _lane_keys(r):
+            refs[k] -= 1
+            if refs[k] == 0:
+                free += shared_full
+        state[uid]["epoch"] += 1  # cancel any scheduled grow/free
+
+    def drain_pending(t):
+        while pending and try_admit(pending[0], t):
+            pending.pop(0)
+
+    for uid, r in enumerate(reqs):
+        # shed screening: can this request EVER fit an EMPTY pool?  A lone
+        # request gets no external sharing, so sharing never lowers this.
+        if policy == "worst":
+            need_ever = r["lanes"] * bps
+        else:
+            need_ever = sum(_ceil_div(max(w, 1), bs)
+                            for w in lane_written(r))
+        if need_ever > B:
+            n["shed"] += 1
+            state[uid]["epoch"] += 1
+            continue
+        push(r.get("t_admit") or 0.0, "arrive", uid, 0)
+
+    while heap:
+        t, _, kind, uid, epoch = heapq.heappop(heap)
+        if kind == "arrive":
+            if pending or not try_admit(uid, t):
+                pending.append(uid)
+                n["deferred"] += 1
+        elif kind == "free":
+            if state[uid]["epoch"] != epoch:
+                continue
+            release(uid)
+            n["completed"] += 1
+            drain_pending(t)
+        elif kind == "grow":
+            if state[uid]["epoch"] != epoch:
+                continue
+            if free < 1:
+                # expected-block pressure: preempt the YOUNGEST other
+                # active request, requeue it at the head (vLLM-style)
+                victims = [u for u in active if u != uid]
+                if not victims:
+                    continue  # screened: cannot happen with headroom
+                v = max(victims, key=lambda u: active[u]["t_admit"])
+                release(v)
+                pending.insert(0, v)
+                n["preemptions"] += 1
+            if free >= 1:
+                free -= 1
+                active[uid]["private"] += 1
+                peak_occ = max(peak_occ, B - free)
+
+    return {
+        "replica": p.get("replica"),
+        "pool_blocks": B, "block_size": bs, "blocks_per_seq": bps,
+        "slots": None if S >= (1 << 30) else S,
+        "requests": len(reqs),
+        "admitted": n["admitted"],
+        "completed": n["completed"],
+        "deferred": n["deferred"],
+        "shed": n["shed"],
+        "preemptions": n["preemptions"],
+        "peak_occupancy_blocks": peak_occ,
+        "peak_concurrent_requests": peak_conc,
+        "mean_steady_demand_blocks": (round(mean_steady, 2)
+                                      if mean_steady else None),
+        "shared_pool_blocks": shared_pool,
+        "admissible_slots": admissible,
+    }
+
+
+# ------------------------------------------------------------------ payload
+def build_payload(pools: Dict[Any, Dict[str, Any]],
+                  grid: Optional[List[Dict[str, Any]]] = None
+                  ) -> Dict[str, Any]:
+    """Validation + lifecycle summaries + the forecast grid (default:
+    recorded geometry x {worst, expected} x {no-sharing, sharing})."""
+    summaries = {}
+    for rep, p in sorted(pools.items(), key=lambda kv: str(kv[0])):
+        summaries[str(rep)] = summarize_pool_events(p)
+    if grid is None:
+        grid = [{"policy": pol, "sharing": sh}
+                for pol in POLICIES for sh in (False, True)]
+    forecasts = [simulate(pools, **g) for g in grid]
+    baseline = next((f for f in forecasts
+                     if f["policy"] == "worst" and not f["sharing"]), None)
+    best = next((f for f in forecasts
+                 if f["policy"] == "expected" and f["sharing"]), None)
+    ratio = None
+    if (baseline and best and baseline.get("admissible_slots")
+            and best.get("admissible_slots") is not None):
+        ratio = round(best["admissible_slots"]
+                      / baseline["admissible_slots"], 2)
+    return {
+        "pools": summaries,
+        "validation": validate(pools),
+        "forecasts": forecasts,
+        "overcommit_slots_ratio": ratio,
+        "caveat": ("forecasts replay the recorded admission order with "
+                   "fixed decode durations; admission-order feedback "
+                   "effects are not modeled"),
+    }
+
+
+def summarize_pool_events(p: Dict[str, Any]) -> Dict[str, Any]:
+    """Offline twin of observability/pool.PoolGauges.summary for one
+    recorded pool: lifetimes, reserved-unused waste, footprints, and the
+    overcommit fit — pure stdlib, computed from the JSONL events."""
+    cfg = p["config"] or {}
+    bs = cfg.get("block_size") or 1
+    nb = cfg.get("num_blocks") or 0
+    bps = cfg.get("blocks_per_seq") or 1
+    lifetimes: List[float] = []
+    footprints: List[float] = []
+    unused = 0
+    reserved_freed = 0
+    lane_sum = 0
+    high_water = 0
+    for ev in p["events"]:
+        if ev.get("op") == "alloc":
+            high_water = max(high_water, ev.get("high_water") or 0)
+    for r in p["requests"]:
+        lane_sum += r["lanes"]
+        if r["t_free"] is not None and r["t_admit"] is not None:
+            lifetimes.append(max(r["t_free"] - r["t_admit"], 0.0))
+        fp = 0
+        per_lane_reserved = (r["reserved"] // r["lanes"]) if r["lanes"] else 0
+        for w in r["written"]:
+            wrote = per_lane_reserved if w is None else _ceil_div(w, bs)
+            wrote = min(wrote, per_lane_reserved)
+            fp += wrote
+            unused += max(per_lane_reserved - wrote, 0)
+            reserved_freed += per_lane_reserved
+        if r["lanes_freed"] >= r["lanes"]:
+            footprints.append(fp)
+    lifetimes.sort()
+    footprints.sort()
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return vals[0]
+        pos = (len(vals) - 1) * q / 100.0
+        lo = int(pos)
+        hi = min(lo + 1, len(vals) - 1)
+        return vals[lo] * (1 - (pos - lo)) + vals[hi] * (pos - lo)
+
+    safe = None
+    if len(footprints) >= 2 and nb:
+        from statistics import NormalDist
+
+        mu = sum(footprints) / len(footprints)
+        var = (sum((f - mu) ** 2 for f in footprints)
+               / (len(footprints) - 1))
+        z = NormalDist().inv_cdf(0.95)
+        s = 0
+        while s < nb and (s + 1) * mu + z * ((s + 1) ** 0.5) * (var ** 0.5) <= nb:
+            s += 1
+        mean_lanes = lane_sum / max(len(p["requests"]), 1)
+        safe = max(s - int(nb // max(mean_lanes * bps, 1)), 0)
+    p50, p99 = pct(lifetimes, 50), pct(lifetimes, 99)
+    f50, f99 = pct(footprints, 50), pct(footprints, 99)
+    return {
+        "config": {k: cfg.get(k) for k in
+                   ("num_blocks", "block_size", "blocks_per_seq",
+                    "num_slots", "n_pre", "n_gen", "kv_quant")},
+        "events": len(p["events"]),
+        "requests": len(p["requests"]),
+        "high_water": high_water,
+        "dropped": p["dropped"],
+        "block_lifetime_p50_s": None if p50 is None else round(p50, 6),
+        "block_lifetime_p99_s": None if p99 is None else round(p99, 6),
+        "reserved_unused_blocks": unused,
+        "reserved_unused_frac": (round(unused / reserved_freed, 4)
+                                 if reserved_freed else None),
+        "footprint_blocks_p50": None if f50 is None else round(f50, 2),
+        "footprint_blocks_p99": None if f99 is None else round(f99, 2),
+        "overcommit_safe_slots": safe,
+    }
+
+
+def pool_section(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The serving_report "pool" section: per-replica lifecycle summaries +
+    the default forecast ratio.  None when the trace has no pool events."""
+    pools = build_pools(records)
+    if not pools:
+        return None
+    payload = build_payload(pools)
+    return {
+        "pools": payload["pools"],
+        "validation_ok": payload["validation"]["ok"],
+        "overcommit_slots_ratio": payload["overcommit_slots_ratio"],
+    }
+
+
+# ------------------------------------------------------------------- render
+def _render(payload: Dict[str, Any]) -> str:
+    out: List[str] = []
+    out.append("== pool lifecycle ==")
+    for rep, s in payload["pools"].items():
+        cfg = s["config"]
+        out.append(
+            f"  replica {rep}: {s['requests']} requests / {s['events']} "
+            f"events | pool {cfg['num_blocks']}x{cfg['block_size']}tok "
+            f"(bps {cfg['blocks_per_seq']}) | high water {s['high_water']}")
+        out.append(
+            f"    block lifetime p50/p99 s: {s['block_lifetime_p50_s']} / "
+            f"{s['block_lifetime_p99_s']} | reserved-unused "
+            f"{s['reserved_unused_blocks']} blocks "
+            f"(frac {s['reserved_unused_frac']})")
+        out.append(
+            f"    footprint blocks p50/p99: {s['footprint_blocks_p50']} / "
+            f"{s['footprint_blocks_p99']} | overcommit-safe extra slots: "
+            f"{s['overcommit_safe_slots']}")
+        if s["dropped"]:
+            out.append(f"    !! recorder dropped {s['dropped']} events")
+    val = payload["validation"]
+    out.append("")
+    out.append(f"== self-validation: {'PASS' if val['ok'] else 'FAIL'} ==")
+    for rep, v in val["pools"].items():
+        out.append(
+            f"  replica {rep}: admitted {v['admitted']} | deferral events "
+            f"{v['deferral_events']} ({v['deferrals_agreed']}/"
+            f"{v['deferrals_replayed']} replayed decisions agree) | "
+            f"high water {v['high_water']} (recorded "
+            f"{v['recorded_high_water']})")
+        for m in v["mismatches"]:
+            out.append(f"    !! {m}")
+    out.append("")
+    out.append("== capacity forecasts (recorded arrival order) ==")
+    hdr = (f"  {'policy':>9} {'share':>6} {'admit':>6} {'defer':>6} "
+           f"{'shed':>5} {'preempt':>8} {'peak_occ':>9} {'peak_conc':>10} "
+           f"{'slots*':>7}")
+    out.append(hdr)
+    for f in payload["forecasts"]:
+        out.append(
+            f"  {f['policy']:>9} {str(f['sharing']):>6} {f['admitted']:>6} "
+            f"{f['deferred']:>6} {f['shed']:>5} {f['preemptions']:>8} "
+            f"{f['peak_occupancy_blocks']:>9} "
+            f"{f['peak_concurrent_requests']:>10} "
+            f"{str(f['admissible_slots']):>7}")
+    out.append("  slots* = analytic admissible requests at steady state "
+               "(pool-bound, lane count ignored)")
+    if payload["overcommit_slots_ratio"] is not None:
+        out.append(
+            f"  expected+sharing vs worst-case admissible slots: "
+            f"{payload['overcommit_slots_ratio']}x at fixed pool bytes")
+    out.append(f"  caveat: {payload['caveat']}")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="KV-pool flight-recorder report + capacity simulator")
+    ap.add_argument("spans", nargs="+",
+                    help="*.spans.jsonl files and/or telemetry dirs")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--validate", action="store_true",
+                    help="self-validation only; exit 1 on mismatch")
+    ap.add_argument("--pool_blocks", type=str, default=None,
+                    help="CSV of hypothetical pool sizes (blocks)")
+    ap.add_argument("--block_size", type=str, default=None,
+                    help="CSV of hypothetical block sizes (tokens)")
+    ap.add_argument("--policy", type=str, default="worst,expected",
+                    help=f"CSV from {POLICIES}")
+    ap.add_argument("--sharing", type=str, default="off,on",
+                    help="CSV from off,on")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="lane cap override (0 = unlimited)")
+    args = ap.parse_args(argv)
+
+    pools = build_pools(load_records(args.spans))
+    if not pools:
+        print("no kind:\"pool\" records found (recorder off, or telemetry "
+              "never flushed)", file=sys.stderr)
+        return 1
+    if args.validate:
+        val = validate(pools)
+        print(json.dumps(val, indent=2) if args.json else
+              _render({"pools": {r: summarize_pool_events(p)
+                                 for r, p in pools.items()},
+                       "validation": val, "forecasts": [],
+                       "overcommit_slots_ratio": None, "caveat": ""}))
+        return 0 if val["ok"] else 1
+
+    grid = []
+    blocks = ([int(x) for x in args.pool_blocks.split(",")]
+              if args.pool_blocks else [None])
+    sizes = ([int(x) for x in args.block_size.split(",")]
+             if args.block_size else [None])
+    for pb in blocks:
+        for bsz in sizes:
+            for pol in args.policy.split(","):
+                for sh in args.sharing.split(","):
+                    grid.append({"pool_blocks": pb, "block_size": bsz,
+                                 "policy": pol.strip(),
+                                 "sharing": sh.strip() == "on",
+                                 "slots": args.slots})
+    payload = build_payload(pools, grid=grid)
+    print(json.dumps(payload, indent=2) if args.json else _render(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,13 @@ Sections, all from the stream serving/engine.py writes:
   runs), a per-replica outcome/latency breakdown plus the `replica_lost`
   drain/requeue story.  Multiple paths merge into one report (per-replica
   telemetry dirs, or one combined stream);
+* **pool** (`kind:"pool"`) — when the KV-pool flight recorder ran, the
+  block-lifecycle story per replica: high-water occupancy, block-lifetime
+  p50/p99, reserved-but-never-written waste, per-request footprint
+  percentiles, the overcommit forecast (expected-blocks + prefix-sharing
+  admissible slots vs worst-case), and whether the capacity simulator's
+  self-validation reproduced the recorded run exactly (tools/
+  pool_report.py has the full what-if grid);
 * **durability** — the PR 14 story: terminal `poisoned` /
   `requeue_exhausted` outcomes, `replica_circuit_open` breaker episodes,
   hedged requests and suppressed duplicate completions, journal-replayed
@@ -55,6 +62,8 @@ from typing import Any, Dict, List
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from telemetry_report import load_records  # noqa: E402 — same torn-line tolerance
+
+import pool_report  # noqa: E402 — kind:"pool" lifecycle + capacity forecast
 
 
 def _pct(vals: List[float], q: float):
@@ -259,6 +268,39 @@ def _durability_section(records: List[Dict[str, Any]],
     return out
 
 
+def _pool_lines(records: List[Dict[str, Any]]) -> List[str]:
+    """KV-pool flight-recorder section (empty when no recorder ran)."""
+    pool = pool_report.pool_section(records)
+    if pool is None:
+        return []
+    out = ["", "kv pool (flight recorder):"]
+    for rep, s in pool["pools"].items():
+        cfg = s["config"]
+        out.append(
+            f"  replica {rep}: {s['requests']} request(s), high water "
+            f"{s['high_water']}/{cfg['num_blocks']} blocks "
+            f"(block_size {cfg['block_size']})")
+        out.append(
+            f"    block lifetime p50/p99: "
+            f"{_ms(s['block_lifetime_p50_s'])} / "
+            f"{_ms(s['block_lifetime_p99_s'])}   reserved-unused: "
+            f"{s['reserved_unused_blocks']} blocks "
+            f"(frac {s['reserved_unused_frac']})")
+        out.append(
+            f"    footprint blocks p50/p99: {s['footprint_blocks_p50']} / "
+            f"{s['footprint_blocks_p99']}   overcommit-safe extra slots: "
+            f"{s['overcommit_safe_slots']}")
+        if s["dropped"]:
+            out.append(f"    !! recorder dropped {s['dropped']} event(s)")
+    out.append(
+        f"  simulator self-validation: "
+        f"{'PASS' if pool['validation_ok'] else 'FAIL'}   "
+        f"expected+sharing vs worst-case admissible slots: "
+        f"{pool['overcommit_slots_ratio']}x "
+        f"(tools/pool_report.py for the what-if grid)")
+    return out
+
+
 _COUNTER_NAMES = (
     "serving/submitted", "serving/admitted", "serving/refused",
     "serving/refused_queue_overflow", "serving/refused_never_fits",
@@ -368,6 +410,9 @@ def build_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         },
         "counters": _counters(records),
     }
+    pool = pool_report.pool_section(records)
+    if pool is not None:
+        summary["pool"] = pool
     if qw:
         summary["quantization"] = {
             k: qw[-1].get(k) for k in
@@ -436,6 +481,7 @@ def build_report(records: List[Dict[str, Any]], max_rows: int = 20) -> str:
 
     out.extend(_fleet_table(reqs, lost_alarms))
     out.extend(_durability_section(records, reqs))
+    out.extend(_pool_lines(records))
 
     if windows:
         out.append("")
